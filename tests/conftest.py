@@ -1,0 +1,42 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference's test strategy (SURVEY.md §4) simulates multi-node with
+multi-process + gloo on localhost. The TPU-native analogue is JAX's CPU
+backend with XLA_FORCE_HOST_PLATFORM_DEVICE_COUNT fake devices — single
+process, 8 devices, real mesh/collective semantics.
+
+Must run before any `import jax` in test modules, hence conftest-level env.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# jax may already be imported (site customization registers a TPU plugin and
+# sets JAX_PLATFORMS before conftest runs); backend init is lazy, so flipping
+# the config here still forces CPU as long as no backend has initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    assert jax.device_count() == 8, "expected 8 virtual CPU devices"
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from tpu_sandbox.runtime.mesh import make_mesh
+
+    return make_mesh({"data": 8})

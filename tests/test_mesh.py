@@ -1,0 +1,52 @@
+"""Mesh / device-group tests — parity with dist group creation
+(reference: allreduce_toy.py:27, mnist_distributed.py:100)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_sandbox.runtime import mesh as meshlib
+
+
+def test_default_mesh_is_1d_data(devices):
+    m = meshlib.make_mesh()
+    assert m.axis_names == ("data",)
+    assert m.shape["data"] == 8
+
+
+def test_multi_axis_mesh(devices):
+    m = meshlib.make_mesh({"data": 2, "model": 4})
+    assert m.shape == {"data": 2, "model": 4}
+
+
+def test_wildcard_axis(devices):
+    m = meshlib.make_mesh({"data": -1, "model": 2})
+    assert m.shape == {"data": 4, "model": 2}
+
+
+def test_bad_sizes_raise(devices):
+    with pytest.raises(ValueError):
+        meshlib.make_mesh({"data": 3})
+    with pytest.raises(ValueError):
+        meshlib.make_mesh({"data": -1, "model": -1})
+    with pytest.raises(ValueError):
+        meshlib.make_mesh({"data": -1, "model": 3})
+
+
+def test_submesh_fixes_other_axes(devices):
+    m = meshlib.make_mesh({"data": 2, "model": 4})
+    sub = meshlib.submesh(m, ["model"])
+    assert sub.axis_names == ("model",)
+    assert sub.shape == {"model": 4}
+    # devices are row 0 of the full grid
+    assert list(sub.devices.ravel()) == list(m.devices[0])
+
+
+def test_shardings(devices):
+    m = meshlib.make_mesh({"data": 8})
+    x = jax.device_put(np.arange(16.0).reshape(8, 2), meshlib.batch_sharding(m))
+    assert x.sharding.spec == P("data")
+    assert len(x.addressable_shards) == 8
+    r = jax.device_put(np.ones(3), meshlib.replicated(m))
+    assert r.sharding.is_fully_replicated

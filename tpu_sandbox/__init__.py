@@ -1,0 +1,25 @@
+"""tpu_sandbox — a TPU-native distributed-training sandbox.
+
+A brand-new framework with the capabilities of the reference
+``torch-distributed-sandbox`` (see SURVEY.md), designed TPU-first:
+
+- runtime/   process bootstrap + rendezvous (jax.distributed) and device meshes
+- parallel/  collectives, data/tensor/pipeline/sequence/expert parallelism
+- models/    Flax model zoo (ConvNet parity model + transformer for the wider
+             parallelism demos)
+- ops/       custom ops (Pallas kernels) and numerics helpers
+- data/      MNIST IDX reader, synthetic data, distributed samplers
+- train/     single-device and distributed trainers
+- utils/     logging, timing, config
+
+The reference is a 4-script torch.distributed sandbox; everything heavyweight
+there lives in PyTorch's C++/CUDA. Here the compute path is JAX/XLA (jit /
+shard_map over an explicit `jax.sharding.Mesh`), and the runtime glue the
+reference borrowed from PyTorch (rendezvous, samplers, data loading) is
+implemented in-tree.
+"""
+
+__version__ = "0.1.0"
+
+from tpu_sandbox import runtime  # noqa: F401
+from tpu_sandbox.runtime import bootstrap, mesh  # noqa: F401

@@ -1,0 +1,13 @@
+from tpu_sandbox.runtime.bootstrap import (  # noqa: F401
+    backend_name,
+    cleanup,
+    coordinator_address,
+    find_free_port,
+    init,
+    is_initialized,
+    process_count,
+    process_index,
+    topology,
+    topology_summary,
+)
+from tpu_sandbox.runtime.mesh import make_mesh, submesh  # noqa: F401

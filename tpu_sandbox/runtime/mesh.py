@@ -1,0 +1,97 @@
+"""Device meshes and device groups.
+
+TPU-native replacement for the reference's process groups
+(``dist.init_process_group`` + ``dist.new_group``; reference:
+allreduce_toy.py:27,44, mnist_distributed.py:50,100). In the reference a
+"group" is a set of ranks with a communicator; here it is a named axis of a
+``jax.sharding.Mesh``, and collectives are ``lax.psum``-family ops over that
+axis name, compiled by XLA into ICI/DCN collectives.
+
+Design notes:
+
+- Meshes/groups are created **once** and reused. The reference creates a
+  fresh group every step (allreduce_toy.py:26-27 and the unused per-step
+  group at mnist_distributed.py:99-100 — a deliberate quirk/leak its README
+  era tolerated). Communicator setup is not free on any fabric; here group
+  creation is explicit, up-front, and cheap to reuse.
+- Multi-axis from day one: data/tensor/pipeline/sequence/expert parallelism
+  are mesh axes, not separate subsystems.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXIS = "data"
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh over (all) devices.
+
+    ``axes`` maps axis name -> size; one entry may be -1 to absorb the
+    remaining devices. Default: a 1-D ``('data',)`` mesh over every device —
+    the reference's world group (its only long-lived group).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {DEFAULT_AXIS: n}
+    axes = OrderedDict(axes)
+
+    wildcard = [k for k, v in axes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if wildcard:
+        fixed = math.prod(v for v in axes.values() if v != -1)
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by the non-wildcard axes of {dict(axes)}"
+            )
+        axes[wildcard[0]] = n // fixed
+
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(f"mesh {dict(axes)} needs {total} devices, have {n}")
+
+    grid = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def submesh(mesh: Mesh, axes: Sequence[str]) -> Mesh:
+    """A mesh over a subset of axes, fixing the others at coordinate 0.
+
+    The once-created analogue of ``dist.new_group(range(args.gpus))``
+    (reference: mnist_distributed.py:100): a group spanning only the local
+    dimension of the device grid.
+    """
+    unknown = set(axes) - set(mesh.axis_names)
+    if unknown:
+        raise ValueError(f"axes {sorted(unknown)} not in mesh axes {mesh.axis_names}")
+    index = tuple(
+        slice(None) if name in axes else 0 for name in mesh.axis_names
+    )
+    grid = mesh.devices[index]
+    kept = tuple(name for name in mesh.axis_names if name in axes)
+    return Mesh(grid.reshape(tuple(mesh.shape[a] for a in kept)), kept)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding that replicates an array on every mesh device (the analogue of
+    DDP's initial param broadcast, reference mnist_distributed.py:67)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DEFAULT_AXIS) -> NamedSharding:
+    """Shard dim 0 (batch) across ``axis`` — DistributedSampler's role
+    (reference: mnist_distributed.py:73-75) expressed as a sharding."""
+    return NamedSharding(mesh, P(axis))
