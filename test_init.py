@@ -1,0 +1,54 @@
+"""Distributed-init smoke test — TPU-native rebuild of the reference
+``test_init.py`` (same flow, same log lines, same exit-0-on-success contract).
+
+Reference behavior (test_init.py:112-117): spawn 4 processes, each sets
+MASTER_ADDR/MASTER_PORT, picks gloo or nccl, calls
+``dist.init_process_group``, prints progress, exits; the parent prints
+``successful test_setup!``. Rank -1 is a "serial code, skip init" sentinel
+(test_init.py:73).
+
+TPU-native shape: there is nothing to spawn — JAX runs one process per host
+and the 4 "ranks" are devices. ``setup_rank`` reports the same per-rank
+progress lines; the rendezvous itself is ``tpu_sandbox.runtime.bootstrap``
+(jax.distributed under the hood for real multi-host jobs). Unlike the
+reference — which defines ``cleanup()`` but never calls it — the group is
+actually torn down at the end.
+"""
+
+import jax
+
+
+def setup_rank(rank: int, world_size: int, port: str, backend: str) -> None:
+    """Per-rank progress report, line-for-line with reference :74-94."""
+    if rank != -1:  # -1 rank indicates serial code
+        print(f"setting up rank={rank} (with world_size={world_size})")
+        MASTER_ADDR = "127.0.0.1"
+        print(f"{MASTER_ADDR=}")
+        print(f"{port=}")
+        print(f"{backend=}")
+        print(f"--> done setting up rank={rank}")
+
+
+def test_setup():
+    print("test_setup")
+    from tpu_sandbox.runtime import bootstrap
+    from tpu_sandbox.runtime.mesh import make_mesh
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    world_size = 4
+    port = bootstrap.find_free_port()
+    devices = ensure_devices(world_size)
+
+    bootstrap.init()
+    backend = bootstrap.backend_name()
+    mesh = make_mesh({"data": world_size}, devices=devices)
+    assert mesh.shape["data"] == world_size
+    for rank in range(world_size):
+        setup_rank(rank, world_size, port, backend)
+    print(bootstrap.topology_summary())
+    bootstrap.cleanup()
+    print("successful test_setup!")
+
+
+if __name__ == "__main__":
+    test_setup()

@@ -1,0 +1,1 @@
+from tpu_sandbox.parallel.collectives import CollectiveGroup  # noqa: F401

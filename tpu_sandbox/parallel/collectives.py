@@ -1,0 +1,236 @@
+"""Raw collectives over a device mesh — the TPU-native L3 layer.
+
+Capability parity with the reference's collective surface
+(``dist.all_reduce(SUM)`` at allreduce_toy.py:31, ``dist.barrier()`` at
+allreduce_toy.py:33, implicit DDP param broadcast at mnist_distributed.py:67,
+``dist.new_group`` at allreduce_toy.py:27 / mnist_distributed.py:100),
+re-expressed the XLA way: a :class:`CollectiveGroup` binds a mesh axis once
+(fixing the reference's group-per-step leak), and each collective is a jit'd
+``shard_map`` whose body is a ``lax`` collective. XLA compiles these into
+ICI/DCN ring or torus collectives — there is no user-level communicator
+management, which is the point.
+
+Data model: a "per-rank value" is an array whose leading dimension is the
+group size, sharded over the group axis — rank i's tensor is row i. This is
+the single-controller analogue of torch's one-tensor-per-process model; it
+works identically on 8 virtual CPU devices, one real chip, or a pod slice.
+"""
+
+from __future__ import annotations
+
+from functools import cached_property, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class CollectiveGroup:
+    """A set of devices that communicate — created once, reused every step.
+
+    The reference creates a fresh ``dist.new_group`` every iteration
+    (allreduce_toy.py:26-27); communicator setup is never free, so here the
+    group (mesh axis binding + compiled collectives) is built once and every
+    call reuses the jit cache.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str | None = None):
+        if axis is None:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    f"mesh has axes {mesh.axis_names}; pass axis= explicitly"
+                )
+            axis = mesh.axis_names[0]
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.size = mesh.shape[axis]
+
+    # -- sharding helpers ---------------------------------------------------
+
+    @cached_property
+    def ranked_sharding(self) -> NamedSharding:
+        """Leading dim = rank over the group axis."""
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def put(self, values) -> jax.Array:
+        """Place a host array of per-rank values (leading dim == group size)."""
+        values = jnp.asarray(values)
+        if values.shape[0] % self.size:
+            raise ValueError(
+                f"leading dim {values.shape[0]} not divisible by group size {self.size}"
+            )
+        return jax.device_put(values, self.ranked_sharding)
+
+    def _smap(self, f, out_specs, check_vma: bool = True):
+        # check_vma=False where the body provably replicates its output
+        # (all_gather/broadcast) but jax's varying-mesh-axes analysis can't
+        # statically see it.
+        return jax.jit(
+            jax.shard_map(
+                f,
+                mesh=self.mesh,
+                in_specs=P(self.axis),
+                out_specs=out_specs,
+                check_vma=check_vma,
+            )
+        )
+
+    # -- collectives --------------------------------------------------------
+
+    @cached_property
+    def _all_reduce_fns(self):
+        def make(reducer):
+            return self._smap(partial(reducer, axis_name=self.axis), P(self.axis))
+
+        return {
+            "sum": make(lax.psum),
+            "mean": make(lax.pmean),
+            "max": make(lax.pmax),
+            "min": make(lax.pmin),
+        }
+
+    def all_reduce(self, values, op: str = "sum") -> jax.Array:
+        """Elementwise reduce across ranks; every rank sees the result.
+
+        Parity: ``dist.all_reduce(tensor, ReduceOp.SUM)`` (allreduce_toy.py:31)
+        and the dead commented-out AVG loss reduce (mnist_distributed.py:102).
+        """
+        if op not in self._all_reduce_fns:
+            raise ValueError(f"op {op!r} not in {sorted(self._all_reduce_fns)}")
+        return self._all_reduce_fns[op](self.put(values))
+
+    @cached_property
+    def _all_gather_fn(self):
+        return self._smap(
+            lambda x: lax.all_gather(x, self.axis, axis=0, tiled=True),
+            P(),
+            check_vma=False,
+        )
+
+    def all_gather(self, values) -> jax.Array:
+        """Every rank receives the concatenation of all ranks' rows."""
+        return self._all_gather_fn(self.put(values))
+
+    @cached_property
+    def _reduce_scatter_fn(self):
+        return self._smap(
+            lambda x: lax.psum_scatter(x, self.axis, scatter_dimension=1, tiled=True),
+            P(self.axis),
+        )
+
+    def reduce_scatter(self, values) -> jax.Array:
+        """Each rank contributes a full payload (its row); the rows are
+        summed and rank i keeps the i-th 1/size slice of the sum.
+
+        ``values``: shape ``(size, m)`` with ``m % size == 0``; returns
+        shape ``(size, m // size)`` where row i is slice i of the sum.
+        """
+        values = jnp.asarray(values)
+        if values.ndim != 2 or values.shape[1] % self.size:
+            raise ValueError(
+                f"reduce_scatter wants shape (size, m) with m % {self.size} == 0, "
+                f"got {values.shape}"
+            )
+        return self._reduce_scatter_fn(self.put(values))
+
+    @cached_property
+    def _broadcast_fn(self):
+        def body(x, root):
+            full = lax.all_gather(x, self.axis, axis=0, tiled=True)
+            return lax.dynamic_index_in_dim(full, root, axis=0, keepdims=False)
+
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(self.axis), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    def broadcast(self, values, root: int = 0) -> jax.Array:
+        """All ranks receive rank ``root``'s row — DDP's initial param sync
+        (mnist_distributed.py:67) as an explicit collective."""
+        return self._broadcast_fn(self.put(values), jnp.asarray(root))
+
+    def _shift_fn(self, offset: int):
+        cache = self.__dict__.setdefault("_shift_cache", {})
+        if offset not in cache:
+            perm = [(i, (i + offset) % self.size) for i in range(self.size)]
+            cache[offset] = self._smap(
+                lambda x: lax.ppermute(x, self.axis, perm), P(self.axis)
+            )
+        return cache[offset]
+
+    def shift(self, values, offset: int = 1) -> jax.Array:
+        """Ring permute: rank i's row moves to rank (i+offset) % size.
+
+        The primitive under ring attention / pipeline p2p — no torch analogue
+        in the reference (it has no send/recv), included because rings are
+        how TPU ICI wants to move data."""
+        return self._shift_fn(offset)(self.put(values))
+
+    @cached_property
+    def _barrier_fn(self):
+        return self._smap(lambda x: lax.psum(x, self.axis), P())
+
+    def barrier(self) -> None:
+        """Block the host until every device in the group has participated.
+
+        Parity: ``dist.barrier()`` (allreduce_toy.py:33). A psum of a unit
+        token; host-blocks on the result.
+        """
+        token = self.put(jnp.ones((self.size,), jnp.int32))
+        self._barrier_fn(token).block_until_ready()
+
+    # -- microbenchmark -----------------------------------------------------
+
+    def allreduce_bandwidth(self, nbytes: int = 1 << 26, iters: int = 10) -> dict:
+        """All-reduce bus bandwidth — the north-star metric BASELINE.md names.
+
+        Returns algorithm bandwidth (payload/time) and bus bandwidth
+        (algbw * 2*(n-1)/n — the standard ring-allreduce accounting, which
+        is what NCCL reports for the reference's fabric).
+        """
+        n = self.size
+        elems = max(nbytes // 4, n)
+        elems -= elems % n
+        x = self.put(jnp.ones((n, elems // n), jnp.float32))
+        fn = self._all_reduce_fns["sum"]
+        fn(x).block_until_ready()  # compile + warm
+        import time
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        algbw = elems * 4 / dt
+        busbw = algbw * (2 * (n - 1) / n)
+        return {
+            "bytes": elems * 4,
+            "seconds": dt,
+            "algbw_GBps": algbw / 1e9,
+            "busbw_GBps": busbw / 1e9,
+        }
+
+
+def world_group(mesh: Mesh | None = None, axis: str = "data") -> CollectiveGroup:
+    """The default all-devices group (the reference's implicit WORLD)."""
+    if mesh is None:
+        from tpu_sandbox.runtime.mesh import make_mesh
+
+        mesh = make_mesh({axis: -1})
+    return CollectiveGroup(mesh, axis)
+
+
+def sub_groups(mesh: Mesh, axis: str) -> CollectiveGroup:
+    """Collectives over one axis of a multi-axis mesh: every slice along the
+    other axes forms an independent group — the once-created analogue of
+    ``dist.new_group(range(args.gpus))`` (mnist_distributed.py:100)."""
+    return CollectiveGroup(mesh, axis)

@@ -1,0 +1,1 @@
+from tpu_sandbox.utils.cli import ensure_devices  # noqa: F401

@@ -1,0 +1,45 @@
+"""Entry-script device bootstrapping.
+
+The reference scripts fork one process per GPU rank (``mp.spawn``); here
+"ranks" are devices of one process. When the user asks for more ranks than
+the accelerator has (the common case on a 1-chip dev box), we fall back to
+N virtual CPU devices — the same trick the reference pulls with
+gloo-on-localhost (SURVEY §4), minus the processes. JAX keeps the CPU
+client alongside the accelerator client, so no platform flip is needed;
+``jax_num_cpu_devices`` just has to be set before any backend initializes,
+which is why entry scripts call this first.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_MAX_VIRTUAL = 64
+
+
+def ensure_devices(n: int, force_cpu: bool = False) -> list:
+    """Return ``n`` devices to act as ranks, virtualizing on CPU if needed.
+
+    Preference order: real accelerator devices if there are enough of them;
+    otherwise ``n`` virtual CPU devices. ``force_cpu`` skips the accelerator
+    (useful for deterministic multi-rank demos on a 1-chip box).
+    """
+    if n < 1:
+        raise ValueError(f"need at least 1 device, asked for {n}")
+    try:
+        # Pre-size the CPU client before any backend initializes so the
+        # fallback exists. Harmless if real devices suffice.
+        jax.config.update("jax_num_cpu_devices", min(max(n, 1), _MAX_VIRTUAL))
+    except RuntimeError:
+        pass  # backends already up; the current CPU client size is fixed
+    if not force_cpu:
+        if jax.device_count() >= n:
+            return jax.devices()[:n]
+    cpu = jax.devices("cpu")
+    if len(cpu) < n:
+        raise RuntimeError(
+            f"wanted {n} ranks; have {jax.device_count()} "
+            f"{jax.default_backend()} device(s) and {len(cpu)} CPU device(s), "
+            "and the CPU client size is already fixed for this process"
+        )
+    return cpu[:n]
