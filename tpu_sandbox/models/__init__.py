@@ -1,0 +1,1 @@
+from tpu_sandbox.models.convnet import ConvNet  # noqa: F401

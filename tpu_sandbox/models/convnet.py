@@ -1,0 +1,63 @@
+"""The parity CNN — the reference's ``ConvNet`` re-expressed in Flax.
+
+Reference architecture (mnist_onegpu.py:11-31 == mnist_distributed.py:25-45,
+duplicated there, single-sourced here):
+
+    [Conv2d(1->16, k5, s1, p2) -> BatchNorm2d(16) -> ReLU -> MaxPool(2,2)]
+    [Conv2d(16->32, k5, s1, p2) -> BatchNorm2d(32) -> ReLU -> MaxPool(2,2)]
+    flatten -> LazyLinear(num_classes)
+
+At the reference's 3000x3000 input the flatten is 32*750*750 = 18M features,
+so the final layer is an 18M x 10 (~180M param) matmul that dominates memory
+— the whole point of its OOM experiment. Flax's init-by-tracing gives
+LazyLinear semantics for free: the Dense in-features are fixed at first
+``init``/tabulate, no dummy-forward dance (reference mnist_onegpu.py:39).
+
+TPU-first choices:
+- NHWC layout (XLA:TPU's native conv layout; torch is NCHW).
+- Optional ``dtype=bfloat16`` compute with fp32 params — the MXU path.
+- BatchNorm carries per-replica batch stats (flax 'batch_stats' collection),
+  NOT cross-replica synced: DDP does not sync BN statistics either, and
+  loss-curve parity requires matching that (SURVEY §7 hard-part 5).
+- BN momentum/eps match torch defaults (torch momentum 0.1 == flax 0.9;
+  eps 1e-5) so running stats evolve identically.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    """Two conv blocks then a lazily-sized classifier head."""
+
+    num_classes: int = 10
+    features: tuple[int, ...] = (16, 32)
+    dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        """x: [N, H, W, C] (NHWC). Returns logits [N, num_classes]."""
+        for i, feat in enumerate(self.features):
+            x = nn.Conv(
+                features=feat,
+                kernel_size=(5, 5),
+                strides=1,
+                padding=2,
+                dtype=self.dtype,
+                name=f"conv{i + 1}",
+            )(x)
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,  # == torch BatchNorm2d momentum 0.1
+                epsilon=1e-5,
+                dtype=self.dtype,
+                name=f"bn{i + 1}",
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        # Flax sizes the kernel from x at init time — LazyLinear semantics.
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return jnp.asarray(x, jnp.float32)  # logits/loss in fp32 always

@@ -1,0 +1,1 @@
+from tpu_sandbox.ops.losses import cross_entropy_loss  # noqa: F401

@@ -1,0 +1,16 @@
+"""Loss ops.
+
+Parity: ``nn.CrossEntropyLoss()`` (reference mnist_onegpu.py:48,
+mnist_distributed.py:64) — softmax cross-entropy with integer labels,
+mean-reduced over the batch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy; logits [N, C] fp32, labels [N] int."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
