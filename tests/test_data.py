@@ -1,0 +1,134 @@
+"""Data-layer tests: IDX reader round-trip, synthetic dataset determinism/
+learnability, DistributedSampler torch-parity structure, BatchLoader."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_sandbox.data import BatchLoader, DistributedSampler, load_mnist, synthetic_mnist
+from tpu_sandbox.data.mnist import normalize
+
+
+def write_idx(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def test_idx_reader_roundtrip(tmp_path):
+    images = np.arange(3 * 28 * 28, dtype=np.uint8).reshape(3, 28, 28)
+    labels = np.array([1, 2, 3], dtype=np.uint8)
+    write_idx(tmp_path / "train-images-idx3-ubyte", images)
+    write_idx(tmp_path / "train-labels-idx1-ubyte", labels)
+    got_i, got_l = load_mnist("train", tmp_path)
+    np.testing.assert_array_equal(got_i, images)
+    np.testing.assert_array_equal(got_l, labels)
+
+
+def test_idx_reader_gzip(tmp_path):
+    labels = np.array([7], dtype=np.uint8)
+    images = np.zeros((1, 28, 28), dtype=np.uint8)
+    for stem, arr in [("t10k-images-idx3-ubyte", images), ("t10k-labels-idx1-ubyte", labels)]:
+        raw = struct.pack(">HBB", 0, 0x08, arr.ndim) + struct.pack(
+            f">{arr.ndim}I", *arr.shape
+        ) + arr.tobytes()
+        with gzip.open(tmp_path / (stem + ".gz"), "wb") as f:
+            f.write(raw)
+    got_i, got_l = load_mnist("test", tmp_path)
+    assert got_i.shape == (1, 28, 28) and got_l[0] == 7
+
+
+def test_load_mnist_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="synthetic_mnist"):
+        load_mnist("train", tmp_path / "nope")
+    with pytest.raises(ValueError, match="split"):
+        load_mnist("validation", tmp_path)
+
+
+def test_synthetic_deterministic_and_classy():
+    i1, l1 = synthetic_mnist(n=256, seed=0)
+    i2, l2 = synthetic_mnist(n=256, seed=0)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_array_equal(l1, l2)
+    assert i1.shape == (256, 28, 28) and i1.dtype == np.uint8
+    # classes must be separable: same-class images closer than cross-class
+    x = normalize(i1).reshape(256, -1)
+    c0, c1 = x[l1 == 0], x[l1 == 1]
+    if len(c0) > 1 and len(c1) > 0:
+        intra = np.linalg.norm(c0[0] - c0[1])
+        inter = np.linalg.norm(c0[0] - c1[0])
+        assert inter > intra
+
+
+def test_normalize():
+    out = normalize(np.full((2, 28, 28), 255, np.uint8))
+    assert out.shape == (2, 28, 28, 1) and out.dtype == np.float32
+    assert out.max() == 1.0
+
+
+def test_sampler_partitions_cover_and_disjoint():
+    s = [DistributedSampler(103, num_replicas=4, rank=r) for r in range(4)]
+    parts = [set(x.indices(0).tolist()) for x in s]
+    assert all(len(p) == 26 for p in parts)  # ceil(103/4)
+    union = set().union(*parts)
+    assert union == set(range(103))  # padding wraps, so all covered
+
+
+def test_sampler_epoch_reshuffle_and_quirk():
+    s = DistributedSampler(100, num_replicas=2, rank=0)
+    a, b = s.indices(0), s.indices(1)
+    assert not np.array_equal(a, b)  # set_epoch changes order
+    np.testing.assert_array_equal(a, s.indices(0))  # reference quirk: epoch 0 reused
+
+
+def test_sampler_matches_torch_structure():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DistributedSampler as TorchSampler
+
+    tds = TorchSampler(range(103), num_replicas=4, rank=2, shuffle=False)
+    ours = DistributedSampler(103, num_replicas=4, rank=2, shuffle=False)
+    np.testing.assert_array_equal(np.fromiter(iter(tds), int), ours.indices())
+
+
+def test_sampler_validates_rank():
+    with pytest.raises(ValueError, match="rank"):
+        DistributedSampler(10, num_replicas=2, rank=2)
+
+
+def test_batch_loader_shapes_and_partial_batch():
+    images, labels = synthetic_mnist(n=23)
+    loader = BatchLoader(images, labels, batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 5 == len(loader)
+    assert batches[0][0].shape == (5, 28, 28)
+    assert batches[-1][0].shape == (3, 28, 28)  # drop_last=False keeps it
+    loader2 = BatchLoader(images, labels, batch_size=5, drop_last=True)
+    assert len(list(loader2)) == 4 == len(loader2)
+
+
+def test_batch_loader_shuffle_reproducible():
+    images, labels = synthetic_mnist(n=50)
+    l1 = BatchLoader(images, labels, batch_size=10, shuffle=True, seed=0)
+    l2 = BatchLoader(images, labels, batch_size=10, shuffle=True, seed=0)
+    np.testing.assert_array_equal(next(iter(l1))[1], next(iter(l2))[1])
+    l1.set_epoch(1)
+    assert not np.array_equal(next(iter(l1))[1], next(iter(l2))[1])
+
+
+def test_batch_loader_with_sampler_shards():
+    images, labels = synthetic_mnist(n=40)
+    loaders = [
+        BatchLoader(
+            images, labels, 5,
+            sampler=DistributedSampler(40, num_replicas=2, rank=r),
+        )
+        for r in range(2)
+    ]
+    seen = [np.concatenate([b[1] for b in ld]) for ld in loaders]
+    assert len(seen[0]) == len(seen[1]) == 20
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        BatchLoader(images, labels, 5, shuffle=True,
+                    sampler=DistributedSampler(40, num_replicas=2, rank=0))
