@@ -1,0 +1,80 @@
+"""Single-device big-image MNIST training — TPU-native rebuild of the
+reference ``mnist_onegpu.py`` (same flags, same log lines, same experiment).
+
+Reference behavior (mnist_onegpu.py:34-96): seed 0, ConvNet with a lazily
+materialized ~180M-param head at 3000x3000, batch size 5 (bs=10 OOMs a 24GB
+A5000 — the README's whole point), CE + SGD(1e-4), loss print every 100
+steps, wall-clock total. Data is MNIST resized 28->3000 per image on the
+host by PIL.
+
+TPU-native shape: one jit'd train step does resize (on device), forward,
+loss, backward, and SGD apply; there is no .cuda() staging, no dummy
+forward (Flax init-by-tracing sizes the head), and the host feeds raw
+28x28 bytes. Without local MNIST IDX files a deterministic synthetic
+MNIST stands in (zero egress — see tpu_sandbox/data/mnist.py).
+"""
+
+import argparse
+
+IMAGE_SHAPE = [3000, 3000]
+
+
+def train(device_index, args):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_sandbox.data import BatchLoader, load_mnist, synthetic_mnist
+    from tpu_sandbox.data.mnist import normalize
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.train import Trainer, TrainState, make_train_step
+
+    rng = jax.random.key(0)  # parity: torch.manual_seed(0), reference :35
+    image_shape = [args.image_size, args.image_size]
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    model = ConvNet(num_classes=10, dtype=dtype)
+    tx = optax.sgd(learning_rate=1e-4)  # reference :49, no momentum
+
+    try:
+        images, labels = load_mnist("train", args.data_dir)
+    except FileNotFoundError:
+        print("MNIST IDX files not found; using deterministic synthetic MNIST")
+        images, labels = synthetic_mnist(n=args.synthetic_n, seed=0)
+    images = normalize(images)
+    labels = labels.astype("int32")
+    if args.limit_steps:
+        images = images[: args.limit_steps * args.batch_size]
+        labels = labels[: args.limit_steps * args.batch_size]
+
+    loader = BatchLoader(
+        images, labels, args.batch_size, shuffle=True, seed=0
+    )  # reference :55-59: shuffle=True, num_workers=0
+
+    state = TrainState.create(
+        model, rng, jnp.zeros([1, *image_shape, 1], dtype), tx
+    )
+    step = make_train_step(model, tx, image_size=tuple(image_shape))
+    trainer = Trainer(step, log_every=args.log_every)
+    trainer.fit(state, loader, args.epochs)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2, help="number of epochs")
+    parser.add_argument("--batch-size", type=int, default=5,
+                        help="reference :45 — bs=10 OOMs one 24GB GPU")
+    parser.add_argument("--image-size", type=int, default=IMAGE_SHAPE[0])
+    parser.add_argument("--data-dir", type=str, default=None,
+                        help="directory with MNIST IDX files; synthetic fallback otherwise")
+    parser.add_argument("--synthetic-n", type=int, default=60000)
+    parser.add_argument("--limit-steps", type=int, default=None,
+                        help="cap steps per epoch (quick runs)")
+    parser.add_argument("--log-every", type=int, default=100)
+    parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16",
+                        help="compute dtype; params and loss stay fp32")
+    args = parser.parse_args()
+    train(0, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Trainer tests: the end-to-end single-device slice at toy scale —
+loss decreases on learnable synthetic data, log-format parity, on-device
+resize path, eval step."""
+
+import re
+
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+import optax
+
+from tpu_sandbox.data import BatchLoader, synthetic_mnist
+from tpu_sandbox.data.mnist import normalize
+from tpu_sandbox.models import ConvNet
+from tpu_sandbox.train import Trainer, TrainState, make_train_step
+from tpu_sandbox.train.trainer import make_eval_step
+
+
+def make_setup(image_size=None, lr=0.05, n=128):
+    model = ConvNet()
+    tx = optax.sgd(lr)
+    shape = (1, *(image_size or (28, 28)), 1)
+    state = TrainState.create(model, jax.random.key(0), jnp.zeros(shape), tx)
+    step = make_train_step(model, tx, image_size=image_size)
+    images, labels = synthetic_mnist(n=n, seed=0)
+    loader = BatchLoader(normalize(images), labels.astype("int32"), 16, shuffle=True)
+    return model, state, step, loader
+
+
+def test_loss_decreases_on_synthetic():
+    _, state, step, loader = make_setup()
+    trainer = Trainer(step, log_every=1, verbose=False)
+    state = trainer.fit(state, loader, epochs=6)
+    first = np.mean(trainer.losses[:4])
+    last = np.mean(trainer.losses[-4:])
+    assert last < first * 0.8, (first, last)
+    assert int(state.step) == 6 * len(loader)
+
+
+def test_log_format_matches_reference(capsys):
+    _, state, step, loader = make_setup(n=32)
+    Trainer(step, log_every=1).fit(state, loader, epochs=1)
+    out = capsys.readouterr().out
+    # reference mnist_onegpu.py:76 format
+    assert re.search(r"Epoch \[1/1\], Step \[1/2\], Loss: \d+\.\d{4}", out)
+    assert "Training complete in: " in out
+
+
+def test_ddp_log_format(capsys):
+    _, state, step, loader = make_setup(n=32)
+    Trainer(step, log_every=1, log_rank=0).fit(state, loader, epochs=1)
+    out = capsys.readouterr().out
+    # reference mnist_distributed.py:105 format
+    assert re.search(r"Rank \[0\], Epoch \[1/1\], Step \[1/2\], Loss: \d+\.\d{4}", out)
+
+
+def test_on_device_resize_path():
+    # feed 28x28, train at 64x64: the resize lives inside the jit'd step
+    _, state, step, loader = make_setup(image_size=(64, 64), n=32)
+    images, labels = next(iter(loader))
+    new_state, loss = step(state, images, labels)
+    assert np.isfinite(float(loss))
+    assert int(new_state.step) == 1
+
+
+def test_batch_stats_evolve_and_params_change():
+    _, state, step, loader = make_setup(n=32)
+    images, labels = next(iter(loader))
+    # copy before stepping: the step donates its input state buffers
+    old_kernel = np.asarray(state.params["conv1"]["kernel"]).copy()
+    new_state, _ = step(state, jnp.asarray(images), jnp.asarray(labels))
+    assert not np.allclose(np.asarray(new_state.params["conv1"]["kernel"]),
+                           old_kernel)
+    assert not np.allclose(np.asarray(new_state.batch_stats["bn1"]["mean"]), 0.0)
+
+
+def test_eval_step_counts_correct():
+    model, state, step, loader = make_setup()
+    state = Trainer(step, verbose=False).fit(state, loader, epochs=6)
+    eval_step = make_eval_step(model)
+    images, labels = synthetic_mnist(n=64, seed=3)
+    correct, loss = eval_step(state, normalize(images), labels.astype("int32"))
+    assert float(correct) / 64 > 0.5  # learnable prototypes: well above chance
+    assert np.isfinite(float(loss))

@@ -1,0 +1,2 @@
+from tpu_sandbox.train.state import TrainState  # noqa: F401
+from tpu_sandbox.train.trainer import Trainer, make_train_step  # noqa: F401

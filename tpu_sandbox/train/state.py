@@ -1,0 +1,42 @@
+"""Train state: params + BN batch stats + optimizer state, as one pytree.
+
+The reference's equivalents are scattered across mutable objects (the torch
+module's parameters/buffers and the SGD optimizer's state, reference
+mnist_onegpu.py:36-49); here they are one immutable pytree so the whole
+update is a pure function XLA can fuse, donate, and shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, model, rng, sample_input, tx: optax.GradientTransformation):
+        """Init by tracing (gives the reference's LazyLinear sizing without
+        its CPU dummy-forward dance, mnist_onegpu.py:39)."""
+        variables = model.init(rng, sample_input, train=False)
+        params = variables["params"]
+        return cls(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+        )
+
+    def variables(self) -> dict:
+        v = {"params": self.params}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        return v

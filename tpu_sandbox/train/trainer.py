@@ -1,0 +1,148 @@
+"""Training engine: jit'd train step + epoch loop with reference log parity.
+
+Single-device parity target is the reference train() (mnist_onegpu.py:34-84):
+CE loss, plain SGD(lr=1e-4), loss print every 100 steps in the exact format
+``Epoch [e/E], Step [s/S], Loss: L``, and a final
+``Training complete in: <timedelta>`` wall-clock line.
+
+TPU-first differences:
+- The whole update (forward, loss, backward, SGD apply, BN stats update) is
+  ONE jit'd pure function with donated state — XLA fuses and schedules it;
+  there is no zero_grad/backward/step choreography.
+- The 28x28 -> HxW upsample happens INSIDE the step, on device
+  (``jax.image.resize``, bilinear like torchvision's default Resize). The
+  reference resizes per-image on the host with PIL (mnist_onegpu.py:53),
+  which would starve a TPU: feeding 3000x3000 fp32 frames is 180 MB/step
+  of host->device traffic vs 4 KB/step for raw 28x28.
+- Optional bf16 compute (model dtype) keeps the MXU fed; the loss/params
+  stay fp32.
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import timedelta
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.train.state import TrainState
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    *,
+    image_size: tuple[int, int] | None = None,
+    donate: bool = True,
+) -> Callable:
+    """Build the jit'd (state, images, labels) -> (state, loss) step.
+
+    ``image_size``: if set, inputs [N,h,w,C] are bilinearly resized to
+    [N,H,W,C] on device before the forward pass.
+    """
+
+    def loss_fn(params, batch_stats, images, labels):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits, mutated = model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        return cross_entropy_loss(logits, labels), mutated.get("batch_stats", {})
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state: TrainState, images: jax.Array, labels: jax.Array):
+        if image_size is not None:
+            n, _, _, c = images.shape
+            images = jax.image.resize(
+                images, (n, *image_size, c), method="bilinear"
+            )
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, images, labels
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            ),
+            loss,
+        )
+
+    return train_step
+
+
+def make_eval_step(model, *, image_size: tuple[int, int] | None = None) -> Callable:
+    """Jit'd (state, images, labels) -> (correct_count, loss_sum)."""
+
+    @jax.jit
+    def eval_step(state: TrainState, images: jax.Array, labels: jax.Array):
+        if image_size is not None:
+            n, _, _, c = images.shape
+            images = jax.image.resize(images, (n, *image_size, c), method="bilinear")
+        logits = model.apply(state.variables(), images, train=False)
+        loss = cross_entropy_loss(logits, labels)
+        correct = jnp.sum(jnp.argmax(logits, -1) == labels)
+        return correct, loss
+
+    return eval_step
+
+
+class Trainer:
+    """Epoch loop with the reference's logging contract."""
+
+    def __init__(
+        self,
+        train_step: Callable,
+        *,
+        log_every: int = 100,
+        log_rank: int | None = None,
+        verbose: bool = True,
+    ):
+        self.train_step = train_step
+        self.log_every = log_every
+        self.log_rank = log_rank  # None: single-device format; int: DDP format
+        self.verbose = verbose
+        self.losses: list[float] = []
+
+    def fit(self, state: TrainState, loader, epochs: int, *, set_epoch: bool = False):
+        """Run ``epochs`` epochs. ``set_epoch=False`` reproduces the
+        reference quirk of never reshuffling the sharded data
+        (no ``sampler.set_epoch``, SURVEY §2.1 C14)."""
+        start = time.monotonic()
+        total_step = len(loader)
+        for epoch in range(epochs):
+            if set_epoch:
+                loader.set_epoch(epoch)
+            for i, (images, labels) in enumerate(loader):
+                state, loss = self.train_step(state, images, labels)
+                if (i + 1) % self.log_every == 0:
+                    loss_val = float(loss)
+                    self.losses.append(loss_val)
+                    if self.verbose:
+                        if self.log_rank is not None:
+                            print(
+                                "Rank [{}], Epoch [{}/{}], Step [{}/{}], Loss: {:.4f}".format(
+                                    self.log_rank, epoch + 1, epochs, i + 1,
+                                    total_step, loss_val,
+                                )
+                            )
+                        else:
+                            print(
+                                "Epoch [{}/{}], Step [{}/{}], Loss: {:.4f}".format(
+                                    epoch + 1, epochs, i + 1, total_step, loss_val
+                                )
+                            )
+        jax.block_until_ready(state)
+        self.elapsed = timedelta(seconds=time.monotonic() - start)
+        if self.verbose:
+            print("Training complete in: " + str(self.elapsed))
+        return state
