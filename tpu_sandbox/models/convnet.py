@@ -35,6 +35,7 @@ class ConvNet(nn.Module):
     num_classes: int = 10
     features: tuple[int, ...] = (16, 32)
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
+    use_bn: bool = True  # False gives a stateless net (exact-DP-equivalence tests)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -48,13 +49,14 @@ class ConvNet(nn.Module):
                 dtype=self.dtype,
                 name=f"conv{i + 1}",
             )(x)
-            x = nn.BatchNorm(
-                use_running_average=not train,
-                momentum=0.9,  # == torch BatchNorm2d momentum 0.1
-                epsilon=1e-5,
-                dtype=self.dtype,
-                name=f"bn{i + 1}",
-            )(x)
+            if self.use_bn:
+                x = nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=0.9,  # == torch BatchNorm2d momentum 0.1
+                    epsilon=1e-5,
+                    dtype=self.dtype,
+                    name=f"bn{i + 1}",
+                )(x)
             x = nn.relu(x)
             x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
         x = x.reshape(x.shape[0], -1)

@@ -1,1 +1,2 @@
 from tpu_sandbox.parallel.collectives import CollectiveGroup  # noqa: F401
+from tpu_sandbox.parallel.data_parallel import DataParallel  # noqa: F401

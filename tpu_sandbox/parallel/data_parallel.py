@@ -1,0 +1,184 @@
+"""Data parallelism — the DDP-equivalent engine, built the XLA way.
+
+Reference counterpart: ``nn.parallel.DistributedDataParallel(model)``
+(mnist_distributed.py:67), whose C++ reducer broadcasts params once and then
+fires bucketed async NCCL all-reduces per gradient bucket during backward.
+
+TPU-native design (SURVEY §1 "TPU mapping", §7 step 6):
+- The whole per-rank training body becomes ONE jit'd ``shard_map`` over a
+  ``Mesh`` axis: the global batch is sharded on that axis, params are
+  replicated, and gradients are ``lax.pmean``'d. XLA's latency-hiding
+  scheduler overlaps the grad all-reduce with remaining backprop — the
+  hand-rolled bucketing DDP does in C++ falls out of the compiler.
+- DDP's initial param broadcast (rank 0 -> all) is a *sharding*: params are
+  placed replicated on the mesh; there is nothing to broadcast at step time.
+- BatchNorm statistics stay **per-replica** (DDP does not sync BN buffers;
+  loss-curve parity requires matching that — SURVEY §7 hard-part 5). Each
+  batch-stats leaf carries a leading mesh-axis dimension and is sharded on
+  it, so rank i's stats live on device i exactly as they would in torch.
+- The per-step loss is rank-local, like DDP's (the reference prints rank 0's
+  loss; its cross-rank AVG all_reduce is dead code at mnist_distributed.py:102).
+  ``average_loss=True`` enables the pmean that dead code intended.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.train.state import TrainState
+
+
+class DataParallel:
+    """Data-parallel train-step factory over one mesh axis.
+
+    Usage::
+
+        dp = DataParallel(model, tx, mesh)          # mesh axis 'data'
+        state = dp.shard_state(state)               # replicate params, split BN
+        state, loss = dp.train_step(state, images, labels)   # global batch
+    """
+
+    def __init__(
+        self,
+        model,
+        tx: optax.GradientTransformation,
+        mesh: Mesh,
+        axis: str = "data",
+        *,
+        image_size: tuple[int, int] | None = None,
+        average_loss: bool = False,
+        donate: bool = True,
+    ):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.model = model
+        self.tx = tx
+        self.mesh = mesh
+        self.axis = axis
+        self.size = mesh.shape[axis]
+        self.image_size = image_size
+        self.average_loss = average_loss
+        self._build(donate)
+
+    # -- state placement ----------------------------------------------------
+
+    def _specs(self, state: TrainState) -> TrainState:
+        """PartitionSpecs mirroring the state pytree: everything replicated
+        except batch-stats, which shard their (added) leading axis."""
+        return TrainState(
+            step=P(),
+            params=jax.tree.map(lambda _: P(), state.params),
+            batch_stats=jax.tree.map(lambda _: P(self.axis), state.batch_stats),
+            opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+        )
+
+    def shard_state(self, state: TrainState) -> TrainState:
+        """Place a single-device state on the mesh: params/opt replicated
+        (DDP's param broadcast), BN stats expanded to one copy per rank."""
+        expanded = state.replace(
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
+                state.batch_stats,
+            )
+        )
+        specs = self._specs(expanded)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            expanded,
+            specs,
+        )
+
+    def unshard_state(self, state: TrainState, rank: int = 0) -> TrainState:
+        """Single-device view: params as-is, rank ``rank``'s BN stats."""
+        return state.replace(
+            batch_stats=jax.tree.map(lambda x: x[rank], state.batch_stats)
+        )
+
+    def shard_batch(self, images, labels):
+        """Place a global batch sharded over the data axis. Device i receives
+        the slice DistributedSampler would have given rank i (see
+        ShardedBatchLoader, which lays the global batch out that way)."""
+        sh = NamedSharding(self.mesh, P(self.axis))
+        return jax.device_put(jnp.asarray(images), sh), jax.device_put(
+            jnp.asarray(labels), sh
+        )
+
+    # -- the engine ---------------------------------------------------------
+
+    def _build(self, donate: bool) -> None:
+        model, tx, axis = self.model, self.tx, self.axis
+        image_size, average_loss = self.image_size, self.average_loss
+
+        def loss_fn(params, batch_stats, images, labels):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            logits, mutated = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            return (
+                cross_entropy_loss(logits, labels),
+                mutated.get("batch_stats", {}),
+            )
+
+        def shard_body(state: TrainState, images, labels):
+            # Per-rank block: images [B/size, ...]; BN stats [1, ...] -> local.
+            local_stats = jax.tree.map(lambda x: x[0], state.batch_stats)
+            if image_size is not None:
+                n, _, _, c = images.shape
+                images = jax.image.resize(
+                    images, (n, *image_size, c), method="bilinear"
+                )
+            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, local_stats, images, labels
+            )
+            # THE data-parallel step: mean grads across ranks. XLA overlaps
+            # this with the rest of backprop (DDP's bucketing, compiled).
+            grads = lax.pmean(grads, axis)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            if average_loss:
+                loss = lax.pmean(loss, axis)  # the reference's dead AVG reduce
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=jax.tree.map(lambda x: x[None], new_stats),
+                opt_state=new_opt,
+            )
+            return new_state, loss[None]
+
+        # Specs are structural: build them from a state *template* lazily on
+        # first call (they depend on the pytree structure, not values).
+        self._jitted: Callable | None = None
+        self._donate = donate
+        self._shard_body = shard_body
+
+    def _compile_for(self, state: TrainState) -> Callable:
+        specs = self._specs(state)
+        smapped = jax.shard_map(
+            self._shard_body,
+            mesh=self.mesh,
+            in_specs=(specs, P(self.axis), P(self.axis)),
+            out_specs=(specs, P(self.axis)),
+            check_vma=False,  # params are replicated by construction (pmean'd
+            # grads + replicated inputs); the static analysis can't see it
+        )
+        return jax.jit(smapped, donate_argnums=(0,) if self._donate else ())
+
+    def train_step(self, state: TrainState, images, labels):
+        """(sharded state, global batch) -> (sharded state, per-rank losses).
+
+        The returned loss has shape [size]; element i is rank i's local loss
+        (DDP parity — print element 0 to match the reference's logs).
+        """
+        if self._jitted is None:
+            self._jitted = self._compile_for(state)
+        return self._jitted(state, images, labels)

@@ -125,7 +125,9 @@ class Trainer:
             for i, (images, labels) in enumerate(loader):
                 state, loss = self.train_step(state, images, labels)
                 if (i + 1) % self.log_every == 0:
-                    loss_val = float(loss)
+                    # DP steps return per-rank losses; log rank 0's, which is
+                    # what the reference prints (mnist_distributed.py:104-106)
+                    loss_val = float(jax.numpy.ravel(loss)[0])
                     self.losses.append(loss_val)
                     if self.verbose:
                         if self.log_rank is not None:

@@ -30,6 +30,10 @@ def ensure_devices(n: int, force_cpu: bool = False) -> list:
         # Pre-size the CPU client before any backend initializes so the
         # fallback exists. Harmless if real devices suffice.
         jax.config.update("jax_num_cpu_devices", min(max(n, 1), _MAX_VIRTUAL))
+        if force_cpu:
+            # Exclude the accelerator platform entirely: initializing it just
+            # to ignore it can hang (and wastes its memory grant).
+            jax.config.update("jax_platforms", "cpu")
     except RuntimeError:
         pass  # backends already up; the current CPU client size is fixed
     if not force_cpu:
