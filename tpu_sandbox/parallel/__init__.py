@@ -1,2 +1,3 @@
 from tpu_sandbox.parallel.collectives import CollectiveGroup  # noqa: F401
 from tpu_sandbox.parallel.data_parallel import DataParallel  # noqa: F401
+from tpu_sandbox.parallel.pjit_engine import PjitEngine  # noqa: F401
