@@ -1,0 +1,115 @@
+"""Decoder-only transformer LM — the long-context / wider-parallelism model.
+
+The reference's only model is a CNN (SURVEY §2.2: TP/PP/SP/EP and attention
+all absent). This framework treats long-context and multi-axis parallelism
+as first-class, so it ships a transformer whose attention implementation is
+*injected*: the same module runs
+
+- single-device with ops.attention.causal_attention (the reference math),
+- sequence-parallel with parallel.ring_attention inside a shard_map over an
+  'sp' mesh axis (see parallel/seq_parallel.py),
+- tensor-parallel via PjitEngine rules on the Dense kernels (qkv/mlp),
+- and with a MoE MLP for expert parallelism (parallel/expert.py).
+
+TPU-first: bf16 compute / fp32 params option, LayerNorm stats in fp32,
+static shapes, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_sandbox.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 2048
+    dtype: Any = jnp.float32
+    # MoE: 0 experts = dense MLP everywhere; >0 = MoE MLP in every block
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+
+
+class SelfAttention(nn.Module):
+    config: TransformerConfig
+    attention_fn: Callable | None = None  # None -> local causal attention
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.n_heads
+        qkv = nn.DenseGeneral(
+            (3, cfg.n_heads, head_dim), dtype=cfg.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attention_fn or (lambda q, k, v: causal_attention(q, k, v))
+        out = attn(q, k, v)  # [B, S, H, D]
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out)
+
+
+class Mlp(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+    attention_fn: Callable | None = None
+    mlp_cls: Any = Mlp
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + SelfAttention(cfg, self.attention_fn, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + self.mlp_cls(cfg, name="mlp")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """tokens [B, S] (+ global positions [B, S] when sequence-sharded)
+    -> logits [B, S, vocab]."""
+
+    config: TransformerConfig
+    attention_fn: Callable | None = None
+    mlp_cls: Any = Mlp
+
+    @nn.compact
+    def __call__(
+        self, tokens: jnp.ndarray, positions: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_emb")(
+            tokens
+        )
+        x = x + nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype, name="pos_emb")(
+            positions
+        )
+        for i in range(cfg.n_layers):
+            x = Block(cfg, self.attention_fn, self.mlp_cls, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
+        return jnp.asarray(logits, jnp.float32)

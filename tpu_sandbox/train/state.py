@@ -26,7 +26,10 @@ class TrainState:
     def create(cls, model, rng, sample_input, tx: optax.GradientTransformation):
         """Init by tracing (gives the reference's LazyLinear sizing without
         its CPU dummy-forward dance, mnist_onegpu.py:39)."""
-        variables = model.init(rng, sample_input, train=False)
+        try:
+            variables = model.init(rng, sample_input, train=False)
+        except TypeError:  # model without a train-mode switch (e.g. the LM)
+            variables = model.init(rng, sample_input)
         params = variables["params"]
         return cls(
             step=jax.numpy.zeros((), jax.numpy.int32),
