@@ -1,0 +1,81 @@
+"""Expert parallelism: switch-style (top-1) Mixture-of-Experts MLP.
+
+The reference has no MoE and no all_to_all (SURVEY §2.2 "EP: ABSENT");
+this module adds the capability TPU-style. The layer is written as pure
+einsum dataflow — gate, capacity-bounded dispatch, per-expert FFN, combine —
+with the expert dimension explicit in every tensor. Expert parallelism is
+then *a sharding rule, not an engine*: shard the expert-weight leading dim
+and the dispatched tensor's expert dim over an 'expert' mesh axis
+(PjitEngine rule ``("w_(up|down)", P("expert", None, None))``) and XLA
+inserts the all-to-alls that route tokens to their expert's device.
+
+Top-1 (Switch Transformer) routing with per-sequence capacity
+C = capacity_factor * S / E: overflow tokens pass through the residual
+(their combine weights are zero), the standard TPU-friendly static-shape
+treatment — no data-dependent shapes, everything MXU-shaped einsums.
+
+The router also exposes its load-balancing auxiliary loss (Switch eq. 4)
+via ``self.sow("aux_loss", ...)`` for engines that want to add it.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_sandbox.models.transformer import TransformerConfig
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MLP replacement for models.transformer.Block (mlp_cls)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        e = cfg.n_experts
+        if e <= 0:
+            raise ValueError("MoeMlp needs config.n_experts > 0")
+        b, s, d = x.shape
+        capacity = max(1, int(cfg.capacity_factor * s / e))
+
+        # --- router (fp32 for numerics) ---
+        gate_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # [B,S,E]
+        probs = jnp.asarray(jax.nn.softmax(gate_logits, axis=-1))
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
+        gate = jnp.max(probs, axis=-1)  # [B,S]
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B,S,E]
+        # position of each token in its expert's queue (per sequence)
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [B,S,E], -1 if not routed
+        in_capacity = (pos >= 0) & (pos < capacity)
+        pos_onehot = jax.nn.one_hot(
+            jnp.where(in_capacity, pos, -1), capacity, dtype=jnp.float32
+        )  # [B,S,E,C] (all-zero row for dropped/unrouted)
+        dispatch = onehot[..., None] * pos_onehot  # [B,S,E,C]
+        combine = dispatch * gate[..., None, None]  # [B,S,E,C]
+
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
+        frac_tokens = jnp.mean(onehot, axis=(0, 1))  # [E]
+        frac_probs = jnp.mean(probs, axis=(0, 1))  # [E]
+        self.sow("aux_loss", "load_balance", e * jnp.sum(frac_tokens * frac_probs))
+
+        # --- dispatch -> expert FFN -> combine (dtype follows the model) ---
+        xd = x.astype(cfg.dtype)
+        dispatched = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), xd
+        )  # [E,B,C,D] — expert dim leading: THE expert-parallel shard dim
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(), (e, d, cfg.d_ff)
+        ).astype(cfg.dtype)
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(), (e, cfg.d_ff, d)
+        ).astype(cfg.dtype)
+        h = nn.gelu(jnp.einsum("ebcd,edf->ebcf", dispatched, w_up))
+        out = jnp.einsum("ebcf,efd->ebcd", h, w_down)  # [E,B,C,D]
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(cfg.dtype), out)
+        return y
