@@ -1,0 +1,282 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a 'pipe' axis.
+
+The reference has no pipeline dimension (SURVEY §2.2 "PP: ABSENT — no stage
+split, no send/recv"); this adds it TPU-style. There are no point-to-point
+sends on a TPU mesh — the pipeline is an SPMD program under ``shard_map``
+where every stage runs the same code each tick and activations move to the
+next stage with ``lax.ppermute`` over neighbor ICI links:
+
+- the transformer's homogeneous blocks are STACKED: their params carry a
+  leading [n_layers] dim, reshaped to [n_stages, layers_per_stage, ...] and
+  sharded on 'pipe' — each device materializes only its own stage's layers
+  (the model-memory win pipeline parallelism exists for);
+- embedding (pre) and head (post) params are replicated; only stage 0's
+  pre output enters the pipe and only the last stage's block output is
+  real — ``where`` masks select them, and the same masks route gradients
+  correctly (pre grads live on stage 0 only, made global with a psum);
+- a batch is split into M microbatches; the loop runs M + S - 1 ticks with
+  the classic (S-1)/(M+S-1) bubble; the tick loop is a ``lax.scan`` so the
+  whole pipeline is one differentiable compiled program — backward runs the
+  reverse pipeline automatically.
+
+Composes with data parallelism over a ('data', 'pipe') mesh: batch sharded
+on 'data', grads pmean'd on 'data'.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_sandbox.models.transformer import Block, TransformerConfig, TransformerLM
+from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.train.state import TrainState
+
+
+def split_transformer_params(params: dict, n_stages: int):
+    """TransformerLM params -> (pre, stacked blocks [L,...], post).
+
+    Blocks are stacked leaf-wise into a leading layer dim; the engine
+    reshapes that to [n_stages, layers_per_stage, ...] and shards it.
+    """
+    block_keys = sorted(
+        (k for k in params if k.startswith("block")), key=lambda k: int(k[5:])
+    )
+    if len(block_keys) % n_stages:
+        raise ValueError(
+            f"{len(block_keys)} layers not divisible into {n_stages} stages"
+        )
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *[params[k] for k in block_keys])
+    pre = {k: params[k] for k in ("tok_emb", "pos_emb")}
+    post = {k: params[k] for k in ("ln_f", "lm_head")}
+    return pre, stacked, post
+
+
+def merge_transformer_params(pre: dict, stacked, post: dict) -> dict:
+    """Inverse of split_transformer_params (for checkpoints/eval parity)."""
+    n_layers = jax.tree.leaves(stacked)[0].shape[0]
+    out = dict(pre)
+    for i in range(n_layers):
+        out[f"block{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    out.update(post)
+    return out
+
+
+class PipelineParallel:
+    """Pipelined TransformerLM training over a ('data', 'pipe') mesh."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        tx: optax.GradientTransformation,
+        mesh: Mesh,
+        *,
+        microbatches: int,
+        data_axis: str = "data",
+        pipe_axis: str = "pipe",
+        donate: bool = True,
+    ):
+        for ax in (data_axis, pipe_axis):
+            if ax not in mesh.axis_names:
+                raise ValueError(f"axis {ax!r} not in mesh axes {mesh.axis_names}")
+        self.config = config
+        self.tx = tx
+        self.mesh = mesh
+        self.microbatches = microbatches
+        self.data_axis, self.pipe_axis = data_axis, pipe_axis
+        self.n_stages = mesh.shape[pipe_axis]
+        if config.n_layers % self.n_stages:
+            raise ValueError(
+                f"{config.n_layers} layers not divisible by {self.n_stages} stages"
+            )
+        self.block = Block(config)
+        self.model = TransformerLM(config)  # init / parity twin
+        self._build(donate)
+
+    # -- state --------------------------------------------------------------
+
+    def init_state(self, rng, sample_tokens) -> TrainState:
+        state = TrainState.create(self.model, rng, sample_tokens, self.tx)
+        pre, stacked, post = split_transformer_params(state.params, self.n_stages)
+        lps = self.config.n_layers // self.n_stages
+        stacked = jax.tree.map(
+            lambda x: x.reshape(self.n_stages, lps, *x.shape[1:]), stacked
+        )
+        params = {"pre": pre, "stages": stacked, "post": post}
+        return state.replace(params=params, opt_state=self.tx.init(params))
+
+    def _param_specs(self, params):
+        return {
+            "pre": jax.tree.map(lambda _: P(), params["pre"]),
+            "stages": jax.tree.map(lambda _: P(self.pipe_axis), params["stages"]),
+            "post": jax.tree.map(lambda _: P(), params["post"]),
+        }
+
+    def _state_specs(self, state: TrainState) -> TrainState:
+        # optimizer states (sgd/adam moments) embed param-shaped leaves whose
+        # paths contain the params subtree names: 'stages' leaves shard on
+        # 'pipe', everything else replicates
+        def opt_leaf_spec(path, _leaf):
+            keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+            return P(self.pipe_axis) if "stages" in keys else P()
+
+        return TrainState(
+            step=P(),
+            params=self._param_specs(state.params),
+            batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+            opt_state=jax.tree_util.tree_map_with_path(opt_leaf_spec, state.opt_state),
+        )
+
+    def shard_state(self, state: TrainState) -> TrainState:
+        specs = self._state_specs(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), state, specs
+        )
+
+    def shard_batch(self, tokens, targets):
+        sh = NamedSharding(self.mesh, P(self.data_axis))
+        return (
+            jax.device_put(jnp.asarray(tokens), sh),
+            jax.device_put(jnp.asarray(targets), sh),
+        )
+
+    # -- the pipeline -------------------------------------------------------
+
+    def _stage_apply(self, stage_params, h):
+        """Apply this stage's layers_per_stage blocks sequentially."""
+
+        def one(hh, layer_params):
+            return self.block.apply({"params": layer_params}, hh), None
+
+        out, _ = lax.scan(one, h, stage_params)
+        return out
+
+    def _build(self, donate: bool) -> None:
+        cfg, n_stages, M = self.config, self.n_stages, self.microbatches
+        daxis, paxis = self.data_axis, self.pipe_axis
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def embed(pre, tokens, positions):
+            tok = pre["tok_emb"]["embedding"][tokens]
+            pos = pre["pos_emb"]["embedding"][positions]
+            return (tok + pos).astype(cfg.dtype)
+
+        def head(post, h):
+            mean = h.mean(-1, keepdims=True)
+            var = h.var(-1, keepdims=True)
+            ln = post["ln_f"]
+            hn = (h - mean) / jnp.sqrt(var + 1e-6) * ln["scale"] + ln["bias"]
+            return (
+                hn.astype(cfg.dtype) @ post["lm_head"]["kernel"]
+                + post["lm_head"]["bias"]
+            ).astype(jnp.float32)
+
+        def pipe_forward(params, tokens):
+            idx = lax.axis_index(paxis)
+            b, s = tokens.shape
+            mb = b // M
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            h = embed(params["pre"], tokens, positions)  # [b, S, D]
+            h_mb = h.reshape(M, mb, s, cfg.d_model)
+            # local stage shard is [1, layers_per_stage, ...]: drop the
+            # sharded singleton, keep the per-stage layer stack for scan
+            my_stage = jax.tree.map(lambda x: x[0], params["stages"])
+
+            outputs0 = jnp.zeros_like(h_mb)
+            state0 = jnp.zeros_like(h_mb[0])
+
+            def tick(carry, t):
+                outputs, buf = carry
+                feed = lax.dynamic_index_in_dim(
+                    h_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                )
+                inp = jnp.where(idx == 0, feed, buf)
+                out = self._stage_apply(my_stage, inp)
+                widx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+                valid = t >= (n_stages - 1)
+                cur = lax.dynamic_index_in_dim(outputs, widx, 0, keepdims=False)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(valid, out, cur), widx, 0
+                )
+                buf = lax.ppermute(out, paxis, perm)
+                return (outputs, buf), None
+
+            (outputs, _), _ = lax.scan(
+                tick, (outputs0, state0), jnp.arange(M + n_stages - 1)
+            )
+            # outputs are only real on the last stage; callers mask by idx.
+            # (Broadcasting them with a psum before the loss would make every
+            # stage backprop a full copy of the loss — psum's transpose SUMS
+            # the cotangents, inflating grads by n_stages.)
+            h_out = outputs.reshape(b, s, cfg.d_model)
+            return head(params["post"], h_out), idx
+
+        def body(state: TrainState, tokens, targets):
+            def loss_fn(params):
+                logits, idx = pipe_forward(params, tokens)
+                ce = cross_entropy_loss(
+                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+                )
+                # the loss is real on the last stage only; masking (rather
+                # than broadcasting) keeps exactly one backprop path alive
+                return jnp.where(idx == n_stages - 1, ce, 0.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            # pre grads are nonzero only on stage 0 (the input where-mask),
+            # post grads only on the last stage (the loss mask); psum makes
+            # both global+replicated. stage grads stay local: no 'pipe' comm.
+            grads = {
+                "pre": lax.psum(grads["pre"], paxis),
+                "stages": grads["stages"],
+                "post": lax.psum(grads["post"], paxis),
+            }
+            grads = lax.pmean(grads, daxis)
+            loss = lax.pmean(lax.psum(loss, paxis), daxis)
+            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+            return (
+                state.replace(
+                    step=state.step + 1,
+                    params=optax.apply_updates(state.params, updates),
+                    opt_state=new_opt,
+                ),
+                loss,
+            )
+
+        self._pipe_forward = pipe_forward
+        self._body = body
+        self._jitted = None
+        self._donate = donate
+
+    def _compile_for(self, state: TrainState) -> Callable:
+        specs = self._state_specs(state)
+        smapped = jax.shard_map(
+            self._body,
+            mesh=self.mesh,
+            in_specs=(specs, P(self.data_axis), P(self.data_axis)),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=(0,) if self._donate else ())
+
+    def train_step(self, state: TrainState, tokens, targets):
+        if self._jitted is None:
+            self._jitted = self._compile_for(state)
+        return self._jitted(state, tokens, targets)
+
+    # -- parity helpers ------------------------------------------------------
+
+    def merged_params(self, state: TrainState) -> dict:
+        stacked = jax.tree.map(
+            lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), state.params["stages"]
+        )
+        return merge_transformer_params(
+            jax.tree.map(np.asarray, state.params["pre"]),
+            stacked,
+            jax.tree.map(np.asarray, state.params["post"]),
+        )
