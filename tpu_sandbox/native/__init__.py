@@ -1,0 +1,1 @@
+from tpu_sandbox.native.build import load_library  # noqa: F401

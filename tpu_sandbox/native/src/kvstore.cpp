@@ -1,0 +1,230 @@
+// Native rendezvous KV store — the TCPStore of this framework.
+//
+// Role parity: the reference's process-group bootstrap rides torch's C++
+// TCPStore (env:// rendezvous behind MASTER_ADDR/MASTER_PORT,
+// reference test_init.py:76-91; SURVEY §2.3). JAX's coordinator service
+// covers the production path; this in-tree store covers the same role for
+// framework-level coordination: rank discovery, key exchange, barriers —
+// usable from multi-process CPU tests exactly like the reference's
+// gloo-on-localhost strategy.
+//
+// Design: one server (thread-per-connection, in-memory map, blocking waits
+// via condition_variable), tiny length-prefixed protocol:
+//   request : op u8 | keylen u32 | key | vallen u32 | val
+//   response: status u8 | vallen u32 | val
+//   ops     : 'S' set, 'G' get (blocks until key exists), 'A' atomic add
+//             (value is decimal i64; returns new value), 'D' delete.
+// C ABI at the bottom; Python wrapper in tpu_sandbox/runtime/kvstore.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<std::thread> conns;
+  std::thread acceptor;
+  std::mutex conns_mu;
+  bool stopping = false;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string& out) {
+  uint32_t len;
+  if (!read_exact(fd, &len, 4)) return false;
+  len = ntohl(len);
+  if (len > (64u << 20)) return false;  // 64MB sanity cap
+  out.resize(len);
+  return len == 0 || read_exact(fd, out.data(), len);
+}
+
+bool write_response(int fd, uint8_t status, const std::string& val) {
+  uint32_t len = htonl((uint32_t)val.size());
+  return write_exact(fd, &status, 1) && write_exact(fd, &len, 4) &&
+         (val.empty() || write_exact(fd, val.data(), val.size()));
+}
+
+void serve_conn(Server* srv, int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_exact(fd, &op, 1)) break;
+    std::string key, val;
+    if (!read_blob(fd, key) || !read_blob(fd, val)) break;
+    if (op == 'S') {
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        srv->data[key] = val;
+      }
+      srv->cv.notify_all();
+      if (!write_response(fd, 0, "")) break;
+    } else if (op == 'G') {
+      std::string out;
+      {
+        std::unique_lock<std::mutex> lk(srv->mu);
+        srv->cv.wait(lk, [&] {
+          return srv->stopping || srv->data.count(key) > 0;
+        });
+        if (srv->stopping) break;
+        out = srv->data[key];
+      }
+      if (!write_response(fd, 0, out)) break;
+    } else if (op == 'A') {
+      int64_t delta = std::strtoll(val.c_str(), nullptr, 10);
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        int64_t cur = 0;
+        auto it = srv->data.find(key);
+        if (it != srv->data.end())
+          cur = std::strtoll(it->second.c_str(), nullptr, 10);
+        now = cur + delta;
+        srv->data[key] = std::to_string(now);
+      }
+      srv->cv.notify_all();
+      if (!write_response(fd, 0, std::to_string(now))) break;
+    } else if (op == 'D') {
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        srv->data.erase(key);
+      }
+      if (!write_response(fd, 0, "")) break;
+    } else {
+      write_response(fd, 1, "bad op");
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+Server* kv_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &alen);
+
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->acceptor = std::thread([srv] {
+    for (;;) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) return;  // listen socket closed -> shutdown
+      std::lock_guard<std::mutex> lk(srv->conns_mu);
+      if (srv->stopping) {
+        ::close(cfd);
+        return;
+      }
+      srv->conns.emplace_back([srv, cfd] { serve_conn(srv, cfd); });
+    }
+  });
+  return srv;
+}
+
+int kv_server_port(Server* srv) { return srv ? srv->port : -1; }
+
+void kv_server_stop(Server* srv) {
+  if (!srv) return;
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    std::lock_guard<std::mutex> lk2(srv->conns_mu);
+    srv->stopping = true;
+  }
+  srv->cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  srv->acceptor.join();
+  for (auto& t : srv->conns) t.join();
+  delete srv;
+}
+
+int kv_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+static bool send_req(int fd, char op, const char* key, int64_t klen,
+                     const char* val, int64_t vlen) {
+  uint8_t opb = (uint8_t)op;
+  uint32_t kl = htonl((uint32_t)klen), vl = htonl((uint32_t)vlen);
+  return write_exact(fd, &opb, 1) && write_exact(fd, &kl, 4) &&
+         (klen == 0 || write_exact(fd, key, (size_t)klen)) &&
+         write_exact(fd, &vl, 4) && (vlen == 0 || write_exact(fd, val, (size_t)vlen));
+}
+
+// Returns value length (copied into out, up to out_cap) or -1 on error.
+int64_t kv_request(int fd, char op, const char* key, int64_t klen,
+                   const char* val, int64_t vlen, char* out, int64_t out_cap) {
+  if (!send_req(fd, op, key, klen, val, vlen)) return -1;
+  uint8_t status;
+  if (!read_exact(fd, &status, 1)) return -1;
+  std::string resp;
+  if (!read_blob(fd, resp)) return -1;
+  if (status != 0) return -1;
+  int64_t n = (int64_t)resp.size();
+  if (out && out_cap > 0) std::memcpy(out, resp.data(), (size_t)std::min(n, out_cap));
+  return n;
+}
+
+void kv_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+"""Python face of the native TCP KV store (rendezvous/coordination).
+
+Role parity with torch's TCPStore behind env:// rendezvous (reference
+test_init.py:76-91, SURVEY §2.3): rank-0 hosts the store, every rank
+connects, and coordination primitives (key exchange, counters, barriers)
+build on set/get/add. The production JAX path uses the coordinator service
+in runtime.bootstrap; this store serves framework-level coordination and
+the multi-process CPU test strategy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+
+def _lib() -> ctypes.CDLL:
+    global _cached
+    try:
+        return _cached
+    except NameError:
+        pass
+    from tpu_sandbox.native import load_library
+
+    lib = load_library("kvstore")
+    lib.kv_server_start.restype = ctypes.c_void_p
+    lib.kv_server_start.argtypes = [ctypes.c_int]
+    lib.kv_server_port.restype = ctypes.c_int
+    lib.kv_server_port.argtypes = [ctypes.c_void_p]
+    lib.kv_server_stop.restype = None
+    lib.kv_server_stop.argtypes = [ctypes.c_void_p]
+    lib.kv_connect.restype = ctypes.c_int
+    lib.kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.kv_request.restype = ctypes.c_int64
+    lib.kv_request.argtypes = [
+        ctypes.c_int, ctypes.c_char, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.kv_close.restype = None
+    lib.kv_close.argtypes = [ctypes.c_int]
+    _cached = lib
+    return lib
+
+
+class KVServer:
+    """In-process store server (rank 0 runs one). port=0 -> OS-assigned."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _lib()
+        self._handle = self._lib.kv_server_start(port)
+        if not self._handle:
+            raise RuntimeError(f"kv_server_start failed on port {port}")
+        self.port = self._lib.kv_server_port(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.kv_server_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class KVClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lib = _lib()
+        self._fd = self._lib.kv_connect(host.encode(), port)
+        if self._fd < 0:
+            raise ConnectionError(f"kv_connect {host}:{port} failed")
+
+    def _request(self, op: str, key: str, val: bytes = b"", cap: int = 1 << 20) -> bytes:
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.kv_request(
+            self._fd, op.encode(), key.encode(), len(key.encode()),
+            val, len(val), out, cap,
+        )
+        if n < 0:
+            raise RuntimeError(f"kv {op} {key!r} failed")
+        return out.raw[:n]
+
+    def set(self, key: str, value: bytes | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._request("S", key, value)
+
+    def get(self, key: str) -> bytes:
+        """Blocks until the key exists (TCPStore wait-get semantics)."""
+        return self._request("G", key)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        """Atomic fetch-add on a decimal counter; returns the new value."""
+        return int(self._request("A", key, str(delta).encode()))
+
+    def delete(self, key: str) -> None:
+        self._request("D", key)
+
+    def barrier(self, world_size: int, key: str = "barrier") -> None:
+        """All ``world_size`` callers block until everyone arrived."""
+        arrived = self.add(f"{key}/count", 1)
+        if arrived == world_size:
+            self.set(f"{key}/done", b"1")
+        self.get(f"{key}/done")  # blocks until released
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.kv_close(self._fd)
+            self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
