@@ -1,0 +1,112 @@
+"""Aux subsystems: checkpoint round-trip + resume, metrics writer,
+step timer, and the Pallas fused CE kernel (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpu_sandbox.models import ConvNet
+from tpu_sandbox.train import TrainState, make_train_step
+from tpu_sandbox.train import checkpoint as ckpt
+from tpu_sandbox.utils.metrics import MetricsWriter, read_metrics
+from tpu_sandbox.utils.profiling import StepTimer
+
+
+def small_state(lr=0.05):
+    model = ConvNet()
+    tx = optax.sgd(lr)
+    state = TrainState.create(model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx)
+    return model, tx, state
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model, tx, state = small_state()
+    step_fn = make_train_step(model, tx, donate=False)
+    from tpu_sandbox.data import synthetic_mnist
+    from tpu_sandbox.data.mnist import normalize
+
+    images, labels = synthetic_mnist(n=8)
+    state, _ = step_fn(state, jnp.asarray(normalize(images)), jnp.asarray(labels.astype("int32")))
+
+    saved_step = ckpt.save(tmp_path / "ck", state)
+    assert saved_step == 1
+    assert ckpt.latest_step(tmp_path / "ck") == 1
+
+    _, _, template = small_state()
+    restored = ckpt.restore(tmp_path / "ck", template)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state, restored,
+    )
+    # resume: training continues from the restored state identically
+    s1, l1 = step_fn(state, jnp.asarray(normalize(images)), jnp.asarray(labels.astype("int32")))
+    s2, l2 = step_fn(restored, jnp.asarray(normalize(images)), jnp.asarray(labels.astype("int32")))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-7)
+
+
+def test_checkpoint_restore_missing_raises(tmp_path):
+    _, _, template = small_state()
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(tmp_path / "empty", template)
+
+
+def test_metrics_writer_roundtrip(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with MetricsWriter(path) as w:
+        w.write(1, loss=1.5, note="a")
+        w.write(2, loss=jnp.asarray(0.75))
+    records = read_metrics(path)
+    assert [r["step"] for r in records] == [1, 2]
+    assert records[1]["loss"] == 0.75
+
+
+def test_step_timer():
+    import time
+
+    t = StepTimer(warmup=1)
+    t.start()
+    for _ in range(4):
+        time.sleep(0.01)
+        t.tick(n_items=10)
+    assert 0.005 < t.seconds_per_step < 0.1
+    assert t.items_per_second > 50
+
+
+def test_pallas_ce_matches_reference():
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+    from tpu_sandbox.ops.pallas_ce import pallas_cross_entropy
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(37, 10)).astype(np.float32)) * 3
+    labels = jnp.asarray(rng.integers(0, 10, size=37).astype(np.int32))
+    ref = cross_entropy_loss(logits, labels)
+    got = pallas_cross_entropy(logits, labels)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+
+def test_pallas_ce_gradient_matches():
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+    from tpu_sandbox.ops.pallas_ce import pallas_cross_entropy
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(16, 64)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 64, size=16).astype(np.int32))
+    g_ref = jax.grad(lambda l: cross_entropy_loss(l, labels))(logits)
+    g_got = jax.grad(lambda l: pallas_cross_entropy(l, labels))(logits)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), atol=1e-6)
+
+
+def test_pallas_ce_large_vocab_block_grid():
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+    from tpu_sandbox.ops.pallas_ce import pallas_cross_entropy
+
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(300, 257)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 257, size=300).astype(np.int32))
+    np.testing.assert_allclose(
+        float(pallas_cross_entropy(logits, labels)),
+        float(cross_entropy_loss(logits, labels)),
+        rtol=1e-6,
+    )

@@ -1,0 +1,98 @@
+"""Pallas TPU kernel: fused softmax-cross-entropy from logits.
+
+The hot ops of the parity experiment (convs, the 18M-wide matmul) belong to
+XLA — hand-scheduling them would fight the compiler (pallas_guide.md: let
+XLA fuse). The loss is the one op where a fused kernel is cleanly separable:
+one VMEM pass computes max, log-sum-exp, and the label logit gather per row
+— no [N, C] softmax materialization in HBM.
+
+Forward runs as a Pallas kernel (grid over row blocks, classes padded to
+the 128-lane tile; padding uses a large-negative filler so exp() underflows
+to 0). Backward is the closed form softmax(logits) - onehot(labels),
+expressed in jnp and left to XLA (it fuses into surrounding backprop).
+
+Falls back to interpret mode off-TPU automatically, so the same call path
+is tested on CPU and compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+_BLOCK_N = 128
+_LANE = 128
+
+
+def _ce_kernel(logits_ref, labels_ref, out_ref):
+    logits = logits_ref[:].astype(jnp.float32)  # [BN, Cp]
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1, keepdims=True)) + m
+    labels = labels_ref[:]  # [BN, 1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    picked = jnp.sum(
+        jnp.where(cols == labels, logits, 0.0), axis=-1, keepdims=True
+    )
+    out_ref[:] = lse - picked
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def pallas_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy; logits [N, C], labels [N] int. Matches
+    ops.losses.cross_entropy_loss numerically (tested)."""
+    return _forward(logits, labels, interpret)
+
+
+def _forward(logits, labels, interpret):
+    n, c = logits.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    np_, cp = _round_up(n, _BLOCK_N), _round_up(c, _LANE)
+    logits_p = jnp.pad(
+        logits.astype(jnp.float32), ((0, np_ - n), (0, cp - c)),
+        constant_values=_NEG,
+    )
+    # padded rows: give them label 0 and a 0-logit at class 0 so their loss
+    # is finite garbage; they are sliced off below
+    logits_p = logits_p.at[n:, 0].set(0.0)
+    labels_p = jnp.pad(labels.astype(jnp.int32), (0, np_ - n))[:, None]
+
+    grid = (np_ // _BLOCK_N,)
+    per_row = pl.pallas_call(
+        _ce_kernel,
+        out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_N, cp), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_N, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_N, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(logits_p, labels_p)
+    return jnp.mean(per_row[:n, 0])
+
+
+def _fwd(logits, labels, interpret):
+    return _forward(logits, labels, interpret), (logits, labels)
+
+
+def _bwd(interpret, res, g):
+    logits, labels = res
+    n = logits.shape[0]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[1], dtype=jnp.float32)
+    dlogits = (g / n) * (probs - onehot)
+    return dlogits.astype(logits.dtype), None
+
+
+pallas_cross_entropy.defvjp(_fwd, _bwd)

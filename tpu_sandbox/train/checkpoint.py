@@ -1,0 +1,55 @@
+"""Checkpoint / resume.
+
+The reference has none (no torch.save/load anywhere — SURVEY §5
+"checkpoint/resume: absent"); a framework needs it, and on TPU the
+idiomatic tool is Orbax (async-capable, sharding-aware: a sharded
+TrainState round-trips with its NamedShardings under the same mesh).
+
+API: ``save(dir, state, step)`` / ``restore(dir, template, step=None)`` /
+``latest_step(dir)``. The template provides structure, dtypes, and (if its
+leaves are sharded) target shardings.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+from tpu_sandbox.train.state import TrainState
+
+
+def _manager(directory: str | os.PathLike, create: bool = True) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        Path(directory).absolute(),
+        options=ocp.CheckpointManagerOptions(create=create, max_to_keep=3),
+    )
+
+
+def save(directory: str | os.PathLike, state: TrainState, step: int | None = None) -> int:
+    """Write a checkpoint; returns the step it was saved under."""
+    step = int(state.step) if step is None else step
+    with _manager(directory) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+    return step
+
+
+def latest_step(directory: str | os.PathLike) -> int | None:
+    with _manager(directory, create=False) as mgr:
+        return mgr.latest_step()
+
+
+def restore(
+    directory: str | os.PathLike, template: TrainState, step: int | None = None
+) -> TrainState:
+    """Restore into the template's structure (and shardings, if sharded)."""
+    with _manager(directory, create=False) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        return mgr.restore(step, args=ocp.args.StandardRestore(abstract))

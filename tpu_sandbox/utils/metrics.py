@@ -1,0 +1,49 @@
+"""Metrics logging.
+
+The reference logs with bare rank-gated prints (SURVEY §5 "metrics:
+print() only"); the Trainer reproduces those lines verbatim for parity.
+This module adds the structured side: a JSONL metrics writer (one record
+per log event, greppable/plottable) and a rank-gated print helper.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+
+
+def rank0_print(*args, **kwargs) -> None:
+    """Print only on process 0 (the reference gates on gpu==0 / rank 0)."""
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics log: {"step": ..., "time": ..., **metrics}."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)
+
+    def write(self, step: int, **metrics) -> None:
+        record = {"step": int(step), "time": time.time()}
+        for k, v in metrics.items():
+            record[k] = float(v) if hasattr(v, "__float__") else v
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_metrics(path: str | Path) -> list[dict]:
+    return [json.loads(line) for line in Path(path).read_text().splitlines() if line]
