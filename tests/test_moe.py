@@ -119,49 +119,16 @@ def test_expert_parallel_sharding_matches_unsharded():
     tx = optax.sgd(0.1)
     tokens, targets = lm_batch()
 
-    class TokenEngine(PjitEngine):
-        def _build(self, state):
-            import optax as _optax
-            from tpu_sandbox.ops.losses import cross_entropy_loss
-            from tpu_sandbox.parallel.pjit_engine import state_specs
-
-            def step(state, tokens, targets):
-                def loss_fn(p):
-                    logits = self.model.apply({"params": p}, tokens)
-                    return cross_entropy_loss(
-                        logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-                    )
-
-                loss, grads = jax.value_and_grad(loss_fn)(state.params)
-                updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-                return (
-                    state.replace(
-                        step=state.step + 1,
-                        params=_optax.apply_updates(state.params, updates),
-                        opt_state=new_opt,
-                    ),
-                    loss,
-                )
-
-            specs = state_specs(state, self.rules)
-            to_sh = lambda tree: jax.tree.map(self._sharding, tree)  # noqa: E731
-            return jax.jit(
-                step,
-                in_shardings=(to_sh(specs), self._sharding(P(self.batch_axis)),
-                              self._sharding(P(self.batch_axis))),
-                out_shardings=(to_sh(specs), self._sharding(P())),
-            )
-
     state = TrainState.create(model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx)
 
     # unsharded reference
-    ref_eng = TokenEngine(model, tx, mesh, donate=False)
+    ref_eng = PjitEngine(model, tx, mesh, task="lm", donate=False)
     ref_state, ref_loss = ref_eng.train_step(
         ref_eng.shard_state(state), *ref_eng.shard_batch(tokens, targets)
     )
 
-    eng = TokenEngine(
-        model, tx, mesh,
+    eng = PjitEngine(
+        model, tx, mesh, task="lm",
         rules=[(r"w_(up|down)", P("expert", None, None))],
         donate=False,
     )

@@ -92,6 +92,30 @@ def test_pjit_tp_row_sharded_head_matches_single_device():
     )
 
 
+def test_pjit_spatial_sharding_matches_single_device():
+    """The CNN's sequence-parallel analog: the image height dim sharded over
+    a 'spatial' axis (XLA inserts conv halo exchanges). Must match the
+    unsharded step."""
+    mesh = make_mesh({"data": 2, "spatial": 4})
+    model, tx, state, images, labels = setup()
+    ref_state, ref_loss = make_train_step(model, tx, donate=False)(
+        state, jnp.asarray(images), jnp.asarray(labels)
+    )
+    eng = PjitEngine(
+        model, tx, mesh, input_spec=P("data", "spatial"), donate=False
+    )
+    sstate = eng.shard_state(state)
+    si, sl = eng.shard_batch(images, labels)
+    assert si.sharding.spec == P("data", "spatial")
+    new_state, loss = eng.train_step(sstate, si, sl)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_state.params["conv1"]["kernel"]),
+        np.asarray(ref_state.params["conv1"]["kernel"]),
+        atol=1e-6,
+    )
+
+
 def test_pjit_with_bn_trains(mesh8):
     model, tx, state, images, labels = setup(use_bn=True)
     eng = PjitEngine(model, tx, mesh8, donate=False)

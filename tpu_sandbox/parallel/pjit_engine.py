@@ -103,9 +103,13 @@ class PjitEngine:
         *,
         rules: Sequence[Rule] = (),
         batch_axis: str = "data",
+        input_spec: P | None = None,
         image_size: tuple[int, int] | None = None,
+        task: str = "image",
         donate: bool = True,
     ):
+        if task not in ("image", "lm"):
+            raise ValueError(f"task must be 'image' or 'lm', got {task!r}")
         if batch_axis not in mesh.axis_names:
             raise ValueError(
                 f"batch axis {batch_axis!r} not in mesh axes {mesh.axis_names}"
@@ -115,7 +119,12 @@ class PjitEngine:
         self.mesh = mesh
         self.rules = list(rules)
         self.batch_axis = batch_axis
+        # input_spec can additionally shard the image dims (spatial
+        # partitioning — XLA inserts conv halo exchanges): e.g.
+        # P('data', 'spatial') splits batch AND image height.
+        self.input_spec = input_spec if input_spec is not None else P(batch_axis)
         self.image_size = image_size
+        self.task = task
         self.donate = donate
         self._jitted: Callable | None = None
 
@@ -129,26 +138,40 @@ class PjitEngine:
         )
 
     def shard_batch(self, images, labels):
-        sh = self._sharding(P(self.batch_axis))
         return (
-            jax.device_put(jnp.asarray(images), sh),
-            jax.device_put(jnp.asarray(labels), sh),
+            jax.device_put(jnp.asarray(images), self._sharding(self.input_spec)),
+            jax.device_put(jnp.asarray(labels), self._sharding(P(self.batch_axis))),
         )
 
     def _build(self, state: TrainState) -> Callable:
         model, tx, image_size = self.model, self.tx, self.image_size
 
-        def loss_fn(params, batch_stats, images, labels):
-            variables = {"params": params}
-            if batch_stats:
-                variables["batch_stats"] = batch_stats
-            logits, mutated = model.apply(
-                variables, images, train=True, mutable=["batch_stats"]
-            )
-            return cross_entropy_loss(logits, labels), mutated.get("batch_stats", {})
+        if self.task == "lm":
+
+            def loss_fn(params, batch_stats, tokens, targets):
+                logits = model.apply({"params": params}, tokens)
+                return (
+                    cross_entropy_loss(
+                        logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+                    ),
+                    batch_stats,
+                )
+
+        else:
+
+            def loss_fn(params, batch_stats, images, labels):
+                variables = {"params": params}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                logits, mutated = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"]
+                )
+                return cross_entropy_loss(logits, labels), mutated.get(
+                    "batch_stats", {}
+                )
 
         def step(state: TrainState, images, labels):
-            if image_size is not None:
+            if image_size is not None and self.task == "image":
                 n, _, _, c = images.shape
                 images = jax.image.resize(
                     images, (n, *image_size, c), method="bilinear"
@@ -173,7 +196,7 @@ class PjitEngine:
             step,
             in_shardings=(
                 to_sh(specs),
-                self._sharding(P(self.batch_axis)),
+                self._sharding(self.input_spec),
                 self._sharding(P(self.batch_axis)),
             ),
             out_shardings=(to_sh(specs), self._sharding(P())),
