@@ -21,6 +21,11 @@ IMAGE_SHAPE = [3000, 3000]
 
 def train(device_index, args):
     import jax
+
+    if args.force_cpu:
+        from tpu_sandbox.utils.cli import ensure_devices
+
+        ensure_devices(1, force_cpu=True)
     import jax.numpy as jnp
     import optax
 
@@ -40,22 +45,40 @@ def train(device_index, args):
     except FileNotFoundError:
         print("MNIST IDX files not found; using deterministic synthetic MNIST")
         images, labels = synthetic_mnist(n=args.synthetic_n, seed=0)
-    images = normalize(images)
-    labels = labels.astype("int32")
     if args.limit_steps:
         images = images[: args.limit_steps * args.batch_size]
         labels = labels[: args.limit_steps * args.batch_size]
 
-    loader = BatchLoader(
-        images, labels, args.batch_size, shuffle=True, seed=0
-    )  # reference :55-59: shuffle=True, num_workers=0
+    # reference :55-59: shuffle=True, num_workers=0. --native-loader swaps in
+    # the C++ worker-pool loader (gather+normalize off the Python thread).
+    if args.native_loader:
+        from tpu_sandbox.data.native_loader import NativeBatchLoader
+
+        loader = NativeBatchLoader(
+            images, labels, args.batch_size, shuffle=True, seed=0, threads=2
+        )
+    else:
+        loader = BatchLoader(
+            normalize(images), labels.astype("int32"), args.batch_size,
+            shuffle=True, seed=0,
+        )
 
     state = TrainState.create(
         model, rng, jnp.zeros([1, *image_shape, 1], dtype), tx
     )
+    if args.ckpt_dir and args.resume:
+        from tpu_sandbox.train import checkpoint as ckpt
+
+        if ckpt.latest_step(args.ckpt_dir) is not None:
+            state = ckpt.restore(args.ckpt_dir, state)
+            print(f"resumed from step {int(state.step)}")
     step = make_train_step(model, tx, image_size=tuple(image_shape))
     trainer = Trainer(step, log_every=args.log_every)
-    trainer.fit(state, loader, args.epochs)
+    state = trainer.fit(state, loader, args.epochs)
+    if args.ckpt_dir:
+        from tpu_sandbox.train import checkpoint as ckpt
+
+        print(f"saved checkpoint at step {ckpt.save(args.ckpt_dir, state)}")
 
 
 def main():
@@ -72,6 +95,14 @@ def main():
     parser.add_argument("--log-every", type=int, default=100)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16",
                         help="compute dtype; params and loss stay fp32")
+    parser.add_argument("--native-loader", action="store_true",
+                        help="use the C++ prefetching data loader")
+    parser.add_argument("--ckpt-dir", type=str, default=None,
+                        help="save a checkpoint here after training")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the latest checkpoint from --ckpt-dir first")
+    parser.add_argument("--force-cpu", action="store_true",
+                        help="run on the CPU backend even if an accelerator is present")
     args = parser.parse_args()
     train(0, args)
 

@@ -7,15 +7,22 @@ MASTER_ADDR/MASTER_PORT, picks gloo or nccl, calls
 ``successful test_setup!``. Rank -1 is a "serial code, skip init" sentinel
 (test_init.py:73).
 
-TPU-native shape: there is nothing to spawn — JAX runs one process per host
-and the 4 "ranks" are devices. ``setup_rank`` reports the same per-rank
-progress lines; the rendezvous itself is ``tpu_sandbox.runtime.bootstrap``
-(jax.distributed under the hood for real multi-host jobs). Unlike the
-reference — which defines ``cleanup()`` but never calls it — the group is
-actually torn down at the end.
+Two modes:
+- default: ranks are devices of one process (the TPU-native shape — one
+  process per HOST, so there is nothing to spawn on a single host).
+- ``--multiprocess``: spawns world_size real OS processes that rendezvous
+  through ``jax.distributed`` on the CPU backend (collectives over Gloo —
+  the same fabric as the reference's CPU fallback) and run a psum sanity
+  check. This is the reference's actual process topology, for parity.
+
+Unlike the reference — which defines ``cleanup()`` but never calls it —
+the group is actually torn down at the end.
 """
 
-import jax
+import argparse
+import os
+import subprocess
+import sys
 
 
 def setup_rank(rank: int, world_size: int, port: str, backend: str) -> None:
@@ -26,29 +33,91 @@ def setup_rank(rank: int, world_size: int, port: str, backend: str) -> None:
         print(f"{MASTER_ADDR=}")
         print(f"{port=}")
         print(f"{backend=}")
-        print(f"--> done setting up rank={rank}")
+        print(f"--> done setting up rank={rank}", flush=True)
 
 
-def test_setup():
+def worker(rank: int, world_size: int, port: str) -> None:
+    """One spawned process: rendezvous, collective sanity check, teardown."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_sandbox.runtime import bootstrap
+
+    bootstrap.init(
+        coordinator=f"127.0.0.1:{port}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+    setup_rank(rank, world_size, port, bootstrap.backend_name())
+
+    # the reference's smoke test stops at rendezvous; we also prove the
+    # group works: a cross-process psum must see every rank
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")),
+        np.full((jax.local_device_count(), 1), float(rank + 1), np.float32),
+        (jax.device_count(), 1),
+    )
+    total = float(jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(x))
+    expected = sum(
+        (r + 1) * (jax.device_count() // world_size) for r in range(world_size)
+    )
+    assert total == expected, (total, expected)
+    print(f"rank {rank}: psum check {total} == {expected}", flush=True)
+    bootstrap.cleanup()
+
+
+def test_setup(world_size: int, multiprocess: bool) -> None:
     print("test_setup")
     from tpu_sandbox.runtime import bootstrap
-    from tpu_sandbox.runtime.mesh import make_mesh
-    from tpu_sandbox.utils.cli import ensure_devices
 
-    world_size = 4
     port = bootstrap.find_free_port()
-    devices = ensure_devices(world_size)
+    if multiprocess:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, __file__, "--worker", "--rank", str(r),
+                 "--world-size", str(world_size), "--port", port],
+                env={**os.environ},
+            )
+            for r in range(world_size)
+        ]
+        codes = [p.wait(timeout=180) for p in procs]
+        if any(codes):
+            raise SystemExit(f"worker exit codes: {codes}")
+    else:
+        from tpu_sandbox.runtime.mesh import make_mesh
+        from tpu_sandbox.utils.cli import ensure_devices
 
-    bootstrap.init()
-    backend = bootstrap.backend_name()
-    mesh = make_mesh({"data": world_size}, devices=devices)
-    assert mesh.shape["data"] == world_size
-    for rank in range(world_size):
-        setup_rank(rank, world_size, port, backend)
-    print(bootstrap.topology_summary())
-    bootstrap.cleanup()
+        devices = ensure_devices(world_size)
+        bootstrap.init()
+        backend = bootstrap.backend_name()
+        mesh = make_mesh({"data": world_size}, devices=devices)
+        assert mesh.shape["data"] == world_size
+        for rank in range(world_size):
+            setup_rank(rank, world_size, port, backend)
+        print(bootstrap.topology_summary())
+        bootstrap.cleanup()
     print("successful test_setup!")
 
 
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world-size", type=int, default=4)
+    parser.add_argument("--multiprocess", action="store_true",
+                        help="spawn real OS processes (reference topology)")
+    parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    parser.add_argument("--port", type=str, default="", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+    if args.worker:
+        worker(args.rank, args.world_size, args.port)
+    else:
+        test_setup(args.world_size, args.multiprocess)
+
+
 if __name__ == "__main__":
-    test_setup()
+    main()
