@@ -1,0 +1,21 @@
+"""Data layer: IDX MNIST reader, synthetic fallback, sharded sampling,
+Python and native (C++) batch loaders.
+
+Parity targets: torchvision MNIST + Resize (reference mnist_onegpu.py:51-54),
+torch DataLoader (mnist_onegpu.py:55-59), DistributedSampler
+(mnist_distributed.py:73-75). The 28->3000 resize is NOT here — it runs
+on-device inside the train step.
+"""
+
+from tpu_sandbox.data.loader import BatchLoader, ShardedBatchLoader
+from tpu_sandbox.data.mnist import load_mnist, normalize, synthetic_mnist
+from tpu_sandbox.data.sampler import DistributedSampler
+
+__all__ = [
+    "BatchLoader",
+    "DistributedSampler",
+    "ShardedBatchLoader",
+    "load_mnist",
+    "normalize",
+    "synthetic_mnist",
+]

@@ -1,0 +1,138 @@
+"""Host-side batch iteration: BatchLoader (single replica) and
+ShardedBatchLoader (all replicas' shards concatenated per step).
+
+Role parity: torch DataLoader as used by the reference — shuffle=True
+single-device (mnist_onegpu.py:55-59), shuffle=False + DistributedSampler
+under DDP (mnist_distributed.py:76-81). One process drives all TPU ranks,
+so the DDP-side loader yields the *global* batch: rank r's per-step slice
+occupies rows [r*bs, (r+1)*bs) and equals exactly what rank r's own
+DistributedSampler would have yielded — the DataParallel engine then
+shards those rows onto the 'data' mesh axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from tpu_sandbox.data.sampler import DistributedSampler
+
+
+class BatchLoader:
+    """Minibatch iterator over in-memory arrays.
+
+    ``shuffle`` uses a ``seed + epoch`` stream (call ``set_epoch``);
+    ``sampler`` restricts iteration to a DistributedSampler shard. The two
+    are mutually exclusive, like torch's DataLoader.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+        sampler: DistributedSampler | None = None,
+    ):
+        if shuffle and sampler is not None:
+            raise ValueError("shuffle and sampler are mutually exclusive")
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.images = images
+        self.labels = labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.sampler = sampler
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        if self.sampler is not None:
+            return self.sampler.indices(self.epoch)
+        if self.shuffle:
+            return np.random.default_rng(self.seed + self.epoch).permutation(
+                len(self.images)
+            )
+        return np.arange(len(self.images))
+
+    def _num_selected(self) -> int:
+        return (
+            self.sampler.per_replica if self.sampler is not None else len(self.images)
+        )
+
+    def __len__(self) -> int:
+        n = self._num_selected()
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        idx = self._indices()
+        if self.drop_last:
+            idx = idx[: (len(idx) // self.batch_size) * self.batch_size]
+        for start in range(0, len(idx), self.batch_size):
+            sel = idx[start : start + self.batch_size]
+            yield self.images[sel], self.labels[sel]
+
+
+class ShardedBatchLoader:
+    """Global-batch iterator for single-process data parallelism.
+
+    Each step yields arrays of ``num_replicas * batch_size`` rows; rows
+    [r*bs, (r+1)*bs) are rank r's DistributedSampler shard in order, so the
+    stream is bit-identical to ``num_replicas`` independent per-rank loaders
+    (asserted in tests/test_data_parallel.py). Shards stay equal-sized at
+    the tail by wrap-padding each rank's index list to a batch multiple —
+    the DP engine needs uniform shard shapes for one jit'd step.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        num_replicas: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        self.images = images
+        self.labels = labels
+        self.batch_size = batch_size
+        self.num_replicas = num_replicas
+        self.samplers = [
+            DistributedSampler(
+                len(images), num_replicas, r, shuffle=shuffle, seed=seed
+            )
+            for r in range(num_replicas)
+        ]
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return math.ceil(self.samplers[0].per_replica / self.batch_size)
+
+    def __iter__(self):
+        steps = len(self)
+        padded = steps * self.batch_size
+        per_rank = []
+        for s in self.samplers:
+            idx = s.indices(self.epoch)
+            if len(idx) < padded:  # wrap-pad so every step has full shards
+                reps = math.ceil(padded / len(idx))
+                idx = np.tile(idx, reps)[:padded]
+            per_rank.append(idx)
+        for step in range(steps):
+            sel = np.concatenate(
+                [idx[step * self.batch_size : (step + 1) * self.batch_size]
+                 for idx in per_rank]
+            )
+            yield self.images[sel], self.labels[sel]

@@ -1,0 +1,141 @@
+"""ctypes front-end for the C++ prefetching data loader
+(tpu_sandbox/native/src/dataloader.cpp).
+
+Role parity: torch's C++ DataLoader machinery behind the reference's
+``DataLoader(..., num_workers=0, pin_memory=True)`` (mnist_onegpu.py:55-59).
+The native side does the per-batch host work — gather rows by index,
+uint8 -> float32/255 (ToTensor semantics) — on a worker pool with a bounded
+in-order prefetch ring, off the Python thread.
+
+Index order (shuffle / sampler / epoch) is computed in NumPy with exactly
+the same streams as the Python ``BatchLoader``, so the two loaders are
+drop-in interchangeable batch-for-batch (asserted in tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+
+import numpy as np
+
+from tpu_sandbox.data.sampler import DistributedSampler
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from tpu_sandbox.native.build import load_library
+
+        lib = load_library("dataloader")
+        lib.loader_create.restype = ctypes.c_void_p
+        lib.loader_create.argtypes = [
+            ctypes.c_void_p,  # images (uint8*)
+            ctypes.c_void_p,  # labels (uint8*)
+            ctypes.c_int64,   # n
+            ctypes.c_int64,   # item_len
+            ctypes.c_int64,   # batch
+            ctypes.c_void_p,  # indices (int64*)
+            ctypes.c_int64,   # n_indices
+            ctypes.c_int,     # threads
+            ctypes.c_int,     # prefetch
+        ]
+        lib.loader_next.restype = ctypes.c_int64
+        lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.loader_num_batches.restype = ctypes.c_int64
+        lib.loader_num_batches.argtypes = [ctypes.c_void_p]
+        lib.loader_destroy.restype = None
+        lib.loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeBatchLoader:
+    """Iterates (float32 [b,H,W,1] normalized images, int32 [b] labels).
+
+    Takes *raw uint8* images/labels (the C side owns the normalize); a new
+    native loader (fresh prefetch ring) is created per epoch iteration.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        sampler: DistributedSampler | None = None,
+        threads: int = 2,
+        prefetch: int = 4,
+    ):
+        if images.dtype != np.uint8 or labels.dtype != np.uint8:
+            raise TypeError(
+                "NativeBatchLoader requires raw uint8 images and labels "
+                f"(got {images.dtype}/{labels.dtype}); it normalizes in C++"
+            )
+        if shuffle and sampler is not None:
+            raise ValueError("shuffle and sampler are mutually exclusive")
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels)
+        self.item_shape = images.shape[1:]
+        self.item_len = int(np.prod(self.item_shape))
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.sampler = sampler
+        self.threads = threads
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        if self.sampler is not None:
+            return self.sampler.indices(self.epoch).astype(np.int64)
+        if self.shuffle:
+            return (
+                np.random.default_rng(self.seed + self.epoch)
+                .permutation(len(self.images))
+                .astype(np.int64)
+            )
+        return np.arange(len(self.images), dtype=np.int64)
+
+    def __len__(self) -> int:
+        n = self.sampler.per_replica if self.sampler is not None else len(self.images)
+        return math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        lib = _load()
+        idx = np.ascontiguousarray(self._indices())
+        handle = lib.loader_create(
+            self.images.ctypes.data,
+            self.labels.ctypes.data,
+            len(self.images),
+            self.item_len,
+            self.batch_size,
+            idx.ctypes.data,
+            len(idx),
+            self.threads,
+            self.prefetch,
+        )
+        if not handle:
+            raise RuntimeError("native loader_create failed (bad indices/args)")
+        out_images = np.empty((self.batch_size, self.item_len), dtype=np.float32)
+        out_labels = np.empty((self.batch_size,), dtype=np.int32)
+        try:
+            while True:
+                count = lib.loader_next(
+                    handle, out_images.ctypes.data, out_labels.ctypes.data
+                )
+                if count == 0:
+                    break
+                batch = out_images[:count].reshape(count, *self.item_shape)[..., None]
+                yield batch.copy(), out_labels[:count].copy()
+        finally:
+            lib.loader_destroy(handle)

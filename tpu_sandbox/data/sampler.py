@@ -1,0 +1,64 @@
+"""Rank-strided dataset sharding with torch-DistributedSampler parity.
+
+The reference shards data with torch.utils.data.DistributedSampler
+(mnist_distributed.py:73-75): pad the index list by wrapping to a multiple
+of ``num_replicas``, then rank r takes indices[r::num_replicas]. The
+shuffle stream is seeded ``seed + epoch``; the reference never calls
+``set_epoch`` so every epoch reuses the epoch-0 order (SURVEY §2.1 C14 —
+a quirk we preserve by defaulting epoch=0).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Yields rank ``rank``'s shard of ``range(num_samples)``.
+
+    Structure-compatible with torch's sampler: equal shard sizes via
+    wrap-padding, rank-strided subsampling, seed+epoch shuffling.
+    """
+
+    def __init__(
+        self,
+        num_samples: int,
+        num_replicas: int,
+        rank: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        if not 0 <= rank < num_replicas:
+            raise ValueError(
+                f"rank {rank} out of range for num_replicas={num_replicas}"
+            )
+        if num_samples <= 0:
+            raise ValueError(f"num_samples must be positive, got {num_samples}")
+        self.num_samples = num_samples
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.per_replica = math.ceil(num_samples / num_replicas)
+        self.total_size = self.per_replica * num_replicas
+
+    def __len__(self) -> int:
+        return self.per_replica
+
+    def indices(self, epoch: int = 0) -> np.ndarray:
+        """This rank's index shard for ``epoch`` (len == per_replica)."""
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(
+                self.num_samples
+            )
+        else:
+            order = np.arange(self.num_samples)
+        pad = self.total_size - self.num_samples
+        if pad:
+            # torch parity: indices += indices[:padding_size] (wrap, not repeat-last)
+            reps = math.ceil(self.total_size / self.num_samples)
+            order = np.tile(order, reps)[: self.total_size]
+        return order[self.rank : self.total_size : self.num_replicas]
