@@ -71,7 +71,8 @@ def worker(rank: int, world_size: int, port: str) -> None:
     bootstrap.cleanup()
 
 
-def test_setup(world_size: int, multiprocess: bool) -> None:
+def test_setup(world_size: int, multiprocess: bool,
+               force_cpu: bool = False) -> None:
     print("test_setup")
     from tpu_sandbox.runtime import bootstrap
 
@@ -92,7 +93,7 @@ def test_setup(world_size: int, multiprocess: bool) -> None:
         from tpu_sandbox.runtime.mesh import make_mesh
         from tpu_sandbox.utils.cli import ensure_devices
 
-        devices = ensure_devices(world_size)
+        devices = ensure_devices(world_size, force_cpu=force_cpu)
         bootstrap.init()
         backend = bootstrap.backend_name()
         mesh = make_mesh({"data": world_size}, devices=devices)
@@ -112,11 +113,14 @@ def main():
     parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
     parser.add_argument("--port", type=str, default="", help=argparse.SUPPRESS)
+    parser.add_argument("--force-cpu", action="store_true",
+                        help="virtual CPU ranks only; skip the accelerator "
+                             "(same flag as the training entry scripts)")
     args = parser.parse_args()
     if args.worker:
         worker(args.rank, args.world_size, args.port)
     else:
-        test_setup(args.world_size, args.multiprocess)
+        test_setup(args.world_size, args.multiprocess, args.force_cpu)
 
 
 if __name__ == "__main__":

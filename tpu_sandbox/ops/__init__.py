@@ -1,1 +1,14 @@
 from tpu_sandbox.ops.losses import cross_entropy_loss  # noqa: F401
+from tpu_sandbox.ops.attention import causal_attention  # noqa: F401
+
+
+def __getattr__(name):
+    # Pallas kernels import jax.experimental.pallas; keep that lazy so the
+    # base package stays importable on minimal installs.
+    if name in ("pallas_cross_entropy",):
+        from tpu_sandbox.ops.pallas_ce import pallas_cross_entropy
+        return pallas_cross_entropy
+    if name in ("flash_attention", "flash_attention_fn"):
+        from tpu_sandbox.ops import pallas_attention
+        return getattr(pallas_attention, name)
+    raise AttributeError(name)

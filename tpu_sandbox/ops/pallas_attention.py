@@ -1,0 +1,257 @@
+"""Pallas TPU kernel: flash attention (online-softmax, O(seq) memory).
+
+The reference repo has no attention anywhere (SURVEY §2.2: ring attention /
+CP "ABSENT" — its model is a CNN), but this framework treats long-context as
+first-class, and attention is the one transformer op where XLA's default
+lowering materializes the [S, S] score matrix in HBM. This kernel never
+does: the forward pass streams K/V blocks through VMEM with the online
+softmax recurrence, so peak memory is O(block_q · block_k) per core instead
+of O(S²), and the matmuls stay on the MXU in the input dtype with fp32
+accumulation.
+
+Shapes and grid:
+- inputs [B, H, S, D] (callers with [B, S, H, D] use ``flash_attention_fn``,
+  which transposes, pads S to the q/k block and D to the 128-lane tile, and
+  undoes both on the way out);
+- grid (B, H, S/block_q, S/block_k), kv innermost ("arbitrary" — it carries
+  the softmax state); m/l/acc live in VMEM scratch across kv steps and the
+  output + logsumexp are written on the last kv step.
+
+Backward is the standard flash backward recomputation — no O(S²) residual is
+saved, only (q, k, v, out, lse). It is expressed as a ``lax.scan`` over kv
+blocks in plain jnp (per SURVEY's "let XLA fuse" stance: the backward is
+bandwidth-bound elementwise+matmul chains XLA schedules well; the win of a
+hand kernel is in the forward's scratch-resident recurrence), so memory stays
+O(S · block_k) and the same code runs on CPU tests and TPU.
+
+Falls back to interpret mode off-TPU automatically, like ops.pallas_ce.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tpu_sandbox.ops.pallas_common import (
+    LANE as _LANE,
+    NEG as _NEG,
+    default_interpret,
+    round_up as _round_up,
+)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                kv_len: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # with causal masking, kv block j contributes to q block i only when the
+    # block diagonals overlap (block_q == block_k ⇒ j <= i)
+    should_run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(should_run)
+    def _step():
+        q = q_ref[0, 0]                      # [block_q, d]
+        k = k_ref[0, 0]                      # [block_k, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                            # [block_q, block_k] fp32
+
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < kv_len               # mask the padded tail keys
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+
+        m_prev = m_scr[:, :1]                # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)               # [block_q, block_k]
+        alpha = jnp.exp(m_prev - m_new)      # [block_q, 1]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
+    """q,k,v [B,H,S,D] (S multiple of blocks, D lane-aligned; ``kv_len`` is
+    the true pre-padding length) -> (out [B,H,S,D], lse [B,H,S])."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = default_interpret(interpret)
+    b, h, s, d = q.shape
+    grid = (b, h, s // block_q, s // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _blockwise_bwd(q, k, v, out, lse, g, scale, causal, block_k, kv_len):
+    """Flash backward: scan over kv blocks, O(S·block_k) live memory.
+
+    Standard formulas with saved lse: p = exp(q·kᵀ·scale − lse);
+    D = rowsum(g ⊙ out); dS = p ⊙ (g·vᵀ − D); dq = dS·k·scale;
+    dk = dSᵀ·q·scale; dv = pᵀ·g.  All per (batch, head) via vmap.
+    """
+    s_len = q.shape[2]
+    n_blocks = s_len // block_k
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    q_pos = jnp.arange(s_len)
+
+    def per_head(q1, k1, v1, lse1, g1, delta1):
+        # q1,k1,v1,g1 [S, D]; lse1, delta1 [S]
+        qf = q1.astype(jnp.float32)
+        gf = g1.astype(jnp.float32)
+
+        def body(dq_acc, jb):
+            ks = jax.lax.dynamic_slice_in_dim(k1, jb * block_k, block_k, 0)
+            vs = jax.lax.dynamic_slice_in_dim(v1, jb * block_k, block_k, 0)
+            ksf = ks.astype(jnp.float32)
+            s_blk = (qf @ ksf.T) * scale                   # [S, block_k]
+            k_pos = jb * block_k + jnp.arange(block_k)
+            mask = (k_pos < kv_len)[None, :]
+            if causal:
+                mask = jnp.logical_and(mask, q_pos[:, None] >= k_pos[None, :])
+            s_blk = jnp.where(mask, s_blk, _NEG)
+            p = jnp.exp(s_blk - lse1[:, None])             # [S, block_k]
+            dv = p.T @ gf                                  # [block_k, D]
+            dp = gf @ vs.astype(jnp.float32).T             # [S, block_k]
+            ds = p * (dp - delta1[:, None])                # [S, block_k]
+            dq_acc = dq_acc + (ds @ ksf) * scale
+            dk = (ds.T @ qf) * scale                       # [block_k, D]
+            return dq_acc, (dk, dv)
+
+        dq, (dks, dvs) = jax.lax.scan(
+            body, jnp.zeros(q1.shape, jnp.float32), jnp.arange(n_blocks)
+        )
+        dk = dks.reshape(s_len, -1)
+        dv = dvs.reshape(s_len, -1)
+        return dq.astype(q1.dtype), dk.astype(k1.dtype), dv.astype(v1.dtype)
+
+    f = jax.vmap(jax.vmap(per_head))
+    return f(q, k, v, lse, g, delta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        kv_len)
+    return out
+
+
+def _core_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                          kv_len)
+    return out, (q, k, v, out, lse)
+
+
+def _core_bwd(scale, causal, block_q, block_k, interpret, kv_len, res, g):
+    q, k, v, out, lse = res
+    return _blockwise_bwd(q, k, v, out, lse, g, scale, causal, block_k,
+                          kv_len)
+
+
+_flash_core.defvjp(_core_fwd, _core_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] inputs (the layout used by
+    models.transformer.SelfAttention and ops.attention.causal_attention,
+    which this matches numerically — tested).
+
+    Pads S up to the block size and D up to the 128-lane tile (zero-padded
+    keys are masked inside the kernel; zero-padded value lanes produce
+    zero output lanes, sliced off).
+    """
+    b, s, h, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    lcm = math.lcm(block_q, block_k)
+    sp = _round_up(max(s, lcm), lcm)
+    dp = _round_up(d, _LANE)
+
+    def prep(x):
+        x = jnp.moveaxis(x, 2, 1)  # [B, H, S, D]
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sp - s), (0, dp - d)))
+
+    out = _flash_core(prep(q), prep(k), prep(v), scale, causal,
+                      block_q, block_k, interpret, s)
+    return jnp.moveaxis(out[:, :, :s, :d], 1, 2)
+
+
+def flash_attention_fn(
+    *, causal: bool = True, block_q: int = 128, block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """An ``attention_fn`` drop-in for models.transformer.TransformerLM."""
+
+    def fn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+
+    return fn

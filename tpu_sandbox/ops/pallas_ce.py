@@ -23,9 +23,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_NEG = -1e30
+from tpu_sandbox.ops.pallas_common import (
+    LANE as _LANE,
+    NEG as _NEG,
+    default_interpret,
+    round_up as _round_up,
+)
+
 _BLOCK_N = 128
-_LANE = 128
 
 
 def _ce_kernel(logits_ref, labels_ref, out_ref):
@@ -40,10 +45,6 @@ def _ce_kernel(logits_ref, labels_ref, out_ref):
     out_ref[:] = lse - picked
 
 
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def pallas_cross_entropy(
     logits: jnp.ndarray, labels: jnp.ndarray, interpret: bool | None = None
@@ -55,8 +56,7 @@ def pallas_cross_entropy(
 
 def _forward(logits, labels, interpret):
     n, c = logits.shape
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = default_interpret(interpret)
     np_, cp = _round_up(n, _BLOCK_N), _round_up(c, _LANE)
     logits_p = jnp.pad(
         logits.astype(jnp.float32), ((0, np_ - n), (0, cp - c)),

@@ -1,0 +1,26 @@
+"""Shared bits for the Pallas TPU kernels (pallas_ce, pallas_attention).
+
+One home for the tile/padding conventions so the kernels can't drift:
+the 128-lane tile width, the large-negative mask filler (chosen so
+``exp(filler - max)`` underflows to 0 in fp32), alignment rounding, and
+the off-TPU interpret-mode fallback that lets the same call path run
+compiled on TPU and interpreted in CPU tests.
+"""
+
+from __future__ import annotations
+
+import jax
+
+NEG = -1e30
+LANE = 128
+
+
+def round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def default_interpret(interpret: bool | None) -> bool:
+    """Kernels compile only on TPU; anywhere else, interpret."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
