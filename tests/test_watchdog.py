@@ -1,0 +1,158 @@
+"""Failure-detection subsystem: heartbeats, watchdog, bounded rendezvous,
+and the numerical sanitizers.
+
+All single-process with threads — the store is real TCP either way, and
+the multiprocess path of the same store is covered by test_native.py.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.watchdog import (
+    DeadRankError,
+    Heartbeat,
+    RendezvousTimeout,
+    Watchdog,
+    wait_for_world,
+)
+
+
+@pytest.fixture()
+def store():
+    with KVServer() as srv:
+        clients = []
+
+        def connect():
+            c = KVClient(port=srv.port)
+            clients.append(c)
+            return c
+
+        yield connect
+        for c in clients:
+            c.close()
+
+
+def test_try_get(store):
+    c = store()
+    assert c.try_get("nope") is None
+    c.set("yes", b"v")
+    assert c.try_get("yes") == b"v"
+
+
+def test_heartbeat_and_watchdog_alive(store):
+    hbs = [Heartbeat(store(), r, interval=0.05).start() for r in range(3)]
+    wd = Watchdog(store(), world_size=3, timeout=1.0)
+    assert wd.dead_ranks() == []
+    wd.assert_all_alive()
+    for hb in hbs:
+        hb.stop()
+
+
+def test_watchdog_detects_dead_rank(store):
+    hb0 = Heartbeat(store(), 0, interval=0.05).start()
+    hb1 = Heartbeat(store(), 1, interval=0.05).start()
+    wd = Watchdog(store(), world_size=2, timeout=0.3)
+    wd.assert_all_alive()
+
+    hb1.stop()  # rank 1 "crashes"
+    wd.check()  # absorb rank 1's final stamp (liveness = stamp *changes*)
+    time.sleep(0.5)
+    assert wd.dead_ranks() == [1]
+    with pytest.raises(DeadRankError, match=r"\[1\]"):
+        wd.assert_all_alive()
+    # health report carries the age of the stale stamp
+    h1 = wd.check()[1]
+    assert h1.last_seen is not None and h1.age > 0.3
+    hb0.stop()
+
+
+def test_watchdog_never_started_rank(store):
+    Heartbeat(store(), 0, interval=0.05).start()
+    wd = Watchdog(store(), world_size=2, timeout=10.0, grace=0.2)
+    assert wd.dead_ranks() == []  # within grace
+    time.sleep(0.3)
+    assert wd.dead_ranks() == [1]  # grace expired, rank 1 never appeared
+
+
+def test_watch_thread_flags_failure(store):
+    hb = Heartbeat(store(), 0, interval=0.05).start()
+    wd = Watchdog(store(), world_size=2, timeout=0.2, grace=0.2)
+    wd.watch(poll=0.05)
+    time.sleep(0.6)
+    wd.stop_watching()
+    assert isinstance(wd.failure, DeadRankError)
+    hb.stop()
+
+
+def test_wait_for_world_success(store):
+    import threading
+
+    errs = []
+
+    def rank(r):
+        try:
+            wait_for_world(store(), 3, r, timeout=5.0)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=rank, args=(r,)) for r in range(3)]
+    [t.start() for t in ts]
+    [t.join(timeout=10) for t in ts]
+    assert not errs
+
+
+def test_wait_for_world_timeout_names_missing(store):
+    with pytest.raises(RendezvousTimeout, match=r"missing ranks: \[1, 2\]"):
+        wait_for_world(store(), 3, 0, timeout=0.3)
+
+
+def test_wait_for_world_regeneration_waits_again(store):
+    """After a successful round, a second round must NOT be satisfied by the
+    first round's keys (elastic-restart scenario)."""
+    import threading
+
+    clients = [store() for _ in range(2)]
+    ts = [
+        threading.Thread(target=wait_for_world, args=(clients[r], 2, r),
+                         kwargs={"timeout": 5.0})
+        for r in range(2)
+    ]
+    [t.start() for t in ts]
+    [t.join(timeout=10) for t in ts]
+
+    # generation 2: only rank 0 shows up -> must time out, not pass
+    with pytest.raises(RendezvousTimeout, match=r"missing ranks: \[1\]"):
+        wait_for_world(clients[0], 2, 0, timeout=0.3)
+
+
+# --- sanitizers ----------------------------------------------------------
+
+def test_assert_finite_names_bad_leaves():
+    from tpu_sandbox.utils.debugging import NonFiniteError, assert_finite
+
+    good = {"a": jnp.ones(3), "b": {"c": jnp.zeros(2)}}
+    assert_finite(good, "good")
+
+    bad = {"a": jnp.ones(3), "b": {"c": jnp.array([1.0, np.nan])}}
+    with pytest.raises(NonFiniteError, match="b/c"):
+        assert_finite(bad, "bad")
+
+
+def test_guarded_step_catches_blowup():
+    from tpu_sandbox.utils.debugging import NonFiniteError, guarded_step
+
+    def step(state, x):
+        state = state + x
+        return state, jnp.sum(state)
+
+    g = guarded_step(jax.jit(step))
+    s = jnp.zeros(2)
+    s, loss = g(s, jnp.ones(2))
+    assert float(loss) == 2.0
+    with pytest.raises(NonFiniteError, match="step 1"):
+        g(s, jnp.array([np.inf, 0.0]))

@@ -12,8 +12,9 @@
 // via condition_variable), tiny length-prefixed protocol:
 //   request : op u8 | keylen u32 | key | vallen u32 | val
 //   response: status u8 | vallen u32 | val
-//   ops     : 'S' set, 'G' get (blocks until key exists), 'A' atomic add
-//             (value is decimal i64; returns new value), 'D' delete.
+//   ops     : 'S' set, 'G' get (blocks until key exists), 'T' try-get
+//             (non-blocking; status 2 when the key is missing), 'A' atomic
+//             add (value is decimal i64; returns new value), 'D' delete.
 // C ABI at the bottom; Python wrapper in tpu_sandbox/runtime/kvstore.py.
 
 #include <arpa/inet.h>
@@ -21,6 +22,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -39,6 +41,7 @@ struct Server {
   std::mutex mu;
   std::condition_variable cv;
   std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
   std::thread acceptor;
   std::mutex conns_mu;
   bool stopping = false;
@@ -105,6 +108,16 @@ void serve_conn(Server* srv, int fd) {
         out = srv->data[key];
       }
       if (!write_response(fd, 0, out)) break;
+    } else if (op == 'T') {
+      std::string out;
+      bool found;
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        auto it = srv->data.find(key);
+        found = it != srv->data.end();
+        if (found) out = it->second;
+      }
+      if (!write_response(fd, found ? 0 : 2, out)) break;
     } else if (op == 'A') {
       int64_t delta = std::strtoll(val.c_str(), nullptr, 10);
       int64_t now;
@@ -129,6 +142,13 @@ void serve_conn(Server* srv, int fd) {
       write_response(fd, 1, "bad op");
       break;
     }
+  }
+  {
+    // deregister before closing: fd numbers get reused, and a stale entry
+    // in conn_fds would make stop() shutdown() an unrelated future socket
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    auto& v = srv->conn_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
   }
   ::close(fd);
 }
@@ -165,6 +185,7 @@ Server* kv_server_start(int port) {
         ::close(cfd);
         return;
       }
+      srv->conn_fds.push_back(cfd);
       srv->conns.emplace_back([srv, cfd] { serve_conn(srv, cfd); });
     }
   });
@@ -184,6 +205,12 @@ void kv_server_stop(Server* srv) {
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   srv->acceptor.join();
+  {
+    // unblock conn threads parked in read() on still-open client sockets —
+    // without this, stop() deadlocks whenever a client outlives the server
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : srv->conns) t.join();
   delete srv;
 }
@@ -211,7 +238,8 @@ static bool send_req(int fd, char op, const char* key, int64_t klen,
          write_exact(fd, &vl, 4) && (vlen == 0 || write_exact(fd, val, (size_t)vlen));
 }
 
-// Returns value length (copied into out, up to out_cap) or -1 on error.
+// Returns value length (copied into out, up to out_cap), -2 when the
+// server reports key-missing (try-get), or -1 on error.
 int64_t kv_request(int fd, char op, const char* key, int64_t klen,
                    const char* val, int64_t vlen, char* out, int64_t out_cap) {
   if (!send_req(fd, op, key, klen, val, vlen)) return -1;
@@ -219,6 +247,7 @@ int64_t kv_request(int fd, char op, const char* key, int64_t klen,
   if (!read_exact(fd, &status, 1)) return -1;
   std::string resp;
   if (!read_blob(fd, resp)) return -1;
+  if (status == 2) return -2;
   if (status != 0) return -1;
   int64_t n = (int64_t)resp.size();
   if (out && out_cap > 0) std::memcpy(out, resp.data(), (size_t)std::min(n, out_cap));

@@ -11,3 +11,10 @@ from tpu_sandbox.runtime.bootstrap import (  # noqa: F401
     topology_summary,
 )
 from tpu_sandbox.runtime.mesh import make_mesh, submesh  # noqa: F401
+from tpu_sandbox.runtime.watchdog import (  # noqa: F401
+    DeadRankError,
+    Heartbeat,
+    RendezvousTimeout,
+    Watchdog,
+    wait_for_world,
+)

@@ -11,6 +11,7 @@ the multi-process CPU test strategy.
 from __future__ import annotations
 
 import ctypes
+import threading
 
 
 def _lib() -> ctypes.CDLL:
@@ -69,13 +70,22 @@ class KVClient:
         self._fd = self._lib.kv_connect(host.encode(), port)
         if self._fd < 0:
             raise ConnectionError(f"kv_connect {host}:{port} failed")
+        # one request-response in flight per connection: the wire protocol is
+        # length-prefixed with no framing recovery, so concurrent callers
+        # (e.g. a Heartbeat thread sharing the owner's client) must serialize
+        self._mu = threading.Lock()
 
-    def _request(self, op: str, key: str, val: bytes = b"", cap: int = 1 << 20) -> bytes:
+    def _request(
+        self, op: str, key: str, val: bytes = b"", cap: int = 1 << 20
+    ) -> bytes | None:
         out = ctypes.create_string_buffer(cap)
-        n = self._lib.kv_request(
-            self._fd, op.encode(), key.encode(), len(key.encode()),
-            val, len(val), out, cap,
-        )
+        with self._mu:
+            n = self._lib.kv_request(
+                self._fd, op.encode(), key.encode(), len(key.encode()),
+                val, len(val), out, cap,
+            )
+        if n == -2:
+            return None  # try-get: key missing
         if n < 0:
             raise RuntimeError(f"kv {op} {key!r} failed")
         return out.raw[:n]
@@ -88,6 +98,12 @@ class KVClient:
     def get(self, key: str) -> bytes:
         """Blocks until the key exists (TCPStore wait-get semantics)."""
         return self._request("G", key)
+
+    def try_get(self, key: str) -> bytes | None:
+        """Non-blocking get: ``None`` when the key does not exist (the poll
+        primitive failure detection needs — a blocking get can't observe
+        'rank never wrote its heartbeat')."""
+        return self._request("T", key)
 
     def add(self, key: str, delta: int = 1) -> int:
         """Atomic fetch-add on a decimal counter; returns the new value."""

@@ -1,1 +1,8 @@
 from tpu_sandbox.utils.cli import ensure_devices  # noqa: F401
+from tpu_sandbox.utils.debugging import (  # noqa: F401
+    NonFiniteError,
+    assert_finite,
+    debug_nans,
+    finite_report,
+    guarded_step,
+)
