@@ -142,6 +142,25 @@ def test_assert_finite_names_bad_leaves():
     with pytest.raises(NonFiniteError, match="b/c"):
         assert_finite(bad, "bad")
 
+    # bf16 (numpy kind 'V') must not slip past the dtype filter — it is the
+    # framework's default compute dtype
+    bad16 = {"p": jnp.array([1.0, np.nan], jnp.bfloat16)}
+    with pytest.raises(NonFiniteError, match="p"):
+        assert_finite(bad16, "bad16")
+    assert_finite({"p": jnp.ones(3, jnp.bfloat16)}, "good16")
+
+
+def test_heartbeat_restart(store):
+    hb = Heartbeat(store(), 0, interval=0.05)
+    hb.start()
+    hb.stop()
+    hb.start()  # must beat again, not exit instantly on the stale stop event
+    wd = Watchdog(store(), world_size=1, timeout=0.4)
+    wd.check()
+    time.sleep(0.2)
+    assert wd.dead_ranks() == []
+    hb.stop()
+
 
 def test_guarded_step_catches_blowup():
     from tpu_sandbox.utils.debugging import NonFiniteError, guarded_step

@@ -67,6 +67,7 @@ class KVServer:
 class KVClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._lib = _lib()
+        self.host, self.port = host, port
         self._fd = self._lib.kv_connect(host.encode(), port)
         if self._fd < 0:
             raise ConnectionError(f"kv_connect {host}:{port} failed")
@@ -98,6 +99,12 @@ class KVClient:
     def get(self, key: str) -> bytes:
         """Blocks until the key exists (TCPStore wait-get semantics)."""
         return self._request("G", key)
+
+    def clone(self) -> "KVClient":
+        """A fresh connection to the same store. Background users (e.g. a
+        Heartbeat) should run on a clone: a blocking ``get`` holds this
+        connection's request lock for its whole server-side wait."""
+        return KVClient(self.host, self.port)
 
     def try_get(self, key: str) -> bytes | None:
         """Non-blocking get: ``None`` when the key does not exist (the poll

@@ -55,7 +55,10 @@ class Heartbeat:
     """
 
     def __init__(self, client: KVClient, rank: int, interval: float = 1.0):
-        self.client = client
+        # beat on a dedicated connection: the owner's blocking get() would
+        # otherwise hold the shared request lock and starve the beats,
+        # turning a slow rendezvous into a false death verdict
+        self.client = client.clone()
         self.rank = rank
         self.interval = interval
         self._stop = threading.Event()
@@ -67,6 +70,7 @@ class Heartbeat:
     def start(self) -> "Heartbeat":
         if self._thread is not None:
             return self
+        self._stop.clear()  # restartable after stop()
         self.beat_once()  # synchronous first beat: visible before start returns
 
         def run():

@@ -21,7 +21,17 @@ from __future__ import annotations
 from contextlib import contextmanager
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+# module-level so jax.jit's identity-keyed cache hits after the first leaf
+_count_nonfinite = jax.jit(lambda x: (~jnp.isfinite(x)).sum())
+
+
+def _is_inexact(dtype) -> bool:
+    """True for float/complex including the ML dtypes (bfloat16, float8_*),
+    whose raw numpy kind is 'V' and would slip past a kind-based check."""
+    return jnp.issubdtype(dtype, jnp.inexact)
 
 
 class NonFiniteError(RuntimeError):
@@ -44,21 +54,25 @@ def finite_report(tree) -> list[str]:
     would raise) are checked with an on-device reduction instead; the
     reduced scalar is replicated, so every process reports consistently.
     """
-    import jax.numpy as jnp
-
     bad: list[str] = []
     for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
         dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
-        if np.dtype(dtype).kind not in "fc":
+        if not _is_inexact(dtype):
             continue
-        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-            n_bad = int(jax.jit(lambda x: (~jnp.isfinite(x)).sum())(leaf))
-            if n_bad:
-                bad.append(
-                    f"{_path_str(path)} ({n_bad}/{leaf.size} non-finite)"
-                )
-            continue
-        arr = np.asarray(leaf)
+        if isinstance(leaf, jax.Array):
+            if leaf.is_fully_addressable:
+                arr = np.asarray(leaf.astype(jnp.float32))
+            else:
+                n_bad = int(_count_nonfinite(leaf))
+                if n_bad:
+                    bad.append(
+                        f"{_path_str(path)} ({n_bad}/{leaf.size} non-finite)"
+                    )
+                continue
+        else:
+            arr = np.asarray(leaf)
+        if arr.dtype.kind not in "fc":  # ml_dtypes: no native np.isfinite
+            arr = arr.astype(np.float32)
         if not np.isfinite(arr).all():
             n = int((~np.isfinite(arr)).sum())
             bad.append(f"{_path_str(path)} ({n}/{arr.size} non-finite)")
