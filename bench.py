@@ -136,9 +136,22 @@ def main():
         result = bench(128, 2, 3, 1, "fp32", True, args.baseline)
     elif args.probe_timeout and not accelerator_usable(args.probe_timeout):
         # accelerator wedged/absent: report an honest degraded-mode number
-        # rather than hanging the driver
-        result = bench(256, 2, 3, 1, "fp32", True, args.baseline)
-        result["degraded"] = "accelerator unavailable; CPU fallback shapes"
+        # rather than hanging the driver (or taking hours at 3000x3000 on
+        # CPU). The line names exactly what was overridden; pass
+        # --probe-timeout 0 to force the requested shapes on CPU.
+        used = dict(image_size=256, batch_per_device=2, steps=3, warmup=1,
+                    dtype="fp32")
+        requested = dict(image_size=args.image_size,
+                         batch_per_device=args.batch_per_device,
+                         steps=args.steps, warmup=args.warmup,
+                         dtype=args.dtype)
+        result = bench(used["image_size"], used["batch_per_device"],
+                       used["steps"], used["warmup"], used["dtype"], True,
+                       args.baseline)
+        overridden = {k: f"{requested[k]}->{used[k]}"
+                      for k in used if requested[k] != used[k]}
+        result["degraded"] = ("accelerator unavailable; CPU fallback "
+                              f"overrode {overridden or 'nothing'}")
     else:
         result = bench(args.image_size, args.batch_per_device, args.steps,
                        args.warmup, args.dtype, False, args.baseline)

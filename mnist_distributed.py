@@ -64,6 +64,12 @@ def train(args, world_size):
     )
 
     state = TrainState.create(model, rng, jnp.zeros([1, *image_shape, 1], dtype), tx)
+    if args.ckpt_dir and args.resume:
+        from tpu_sandbox.train import checkpoint as ckpt
+
+        if ckpt.latest_step(args.ckpt_dir) is not None:
+            state = ckpt.restore(args.ckpt_dir, state)
+            print(f"resumed from step {int(state.step)}")
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape))
     dstate = dp.shard_state(state)
 
@@ -71,7 +77,14 @@ def train(args, world_size):
         return dp.train_step(s, *dp.shard_batch(images_np, labels_np))
 
     trainer = Trainer(step, log_every=args.log_every, log_rank=0)
-    trainer.fit(dstate, loader, args.epochs, set_epoch=False)
+    dstate = trainer.fit(dstate, loader, args.epochs, set_epoch=False)
+    if args.ckpt_dir:
+        from tpu_sandbox.train import checkpoint as ckpt
+
+        # checkpoint the single-device view (rank 0's BN stats), the same
+        # layout mnist_onegpu saves — the two scripts' checkpoints interop
+        print(f"saved checkpoint at step "
+              f"{ckpt.save(args.ckpt_dir, dp.unshard_state(dstate))}")
     bootstrap.cleanup()
 
 
@@ -93,6 +106,10 @@ def main():
     parser.add_argument("--limit-steps", type=int, default=None)
     parser.add_argument("--log-every", type=int, default=100)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    parser.add_argument("--ckpt-dir", type=str, default=None,
+                        help="orbax checkpoint dir (save at end of training)")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the latest checkpoint before training")
     parser.add_argument("--force-cpu", action="store_true",
                         help="use virtual CPU devices even if an accelerator is present")
     args = parser.parse_args()

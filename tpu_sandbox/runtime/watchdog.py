@@ -55,14 +55,22 @@ class Heartbeat:
     """
 
     def __init__(self, client: KVClient, rank: int, interval: float = 1.0):
-        # beat on a dedicated connection: the owner's blocking get() would
-        # otherwise hold the shared request lock and starve the beats,
-        # turning a slow rendezvous into a false death verdict
-        self.client = client.clone()
+        self._owner = client
         self.rank = rank
         self.interval = interval
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._clone: KVClient | None = None
+
+    @property
+    def client(self) -> KVClient:
+        """The beat connection: a dedicated clone of the owner's client (the
+        owner's blocking get() would otherwise hold the shared request lock
+        and starve beats into a false death verdict). Created on first use,
+        closed by stop() so repeated start/stop cycles don't leak sockets."""
+        if self._clone is None:
+            self._clone = self._owner.clone()
+        return self._clone
 
     def beat_once(self) -> None:
         self.client.set(_hb_key(self.rank), repr(time.time()).encode())
@@ -93,6 +101,9 @@ class Heartbeat:
                 self.client.delete(_hb_key(self.rank))
             except Exception:
                 pass
+        if self._clone is not None:
+            self._clone.close()
+            self._clone = None
 
     def __enter__(self):
         return self.start()

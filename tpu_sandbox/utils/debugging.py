@@ -61,7 +61,7 @@ def finite_report(tree) -> list[str]:
             continue
         if isinstance(leaf, jax.Array):
             if leaf.is_fully_addressable:
-                arr = np.asarray(leaf.astype(jnp.float32))
+                arr = np.asarray(leaf)
             else:
                 n_bad = int(_count_nonfinite(leaf))
                 if n_bad:
@@ -71,8 +71,10 @@ def finite_report(tree) -> list[str]:
                 continue
         else:
             arr = np.asarray(leaf)
-        if arr.dtype.kind not in "fc":  # ml_dtypes: no native np.isfinite
-            arr = arr.astype(np.float32)
+        if arr.dtype.kind not in "fc":  # ml_dtypes (bf16/fp8): kind 'V',
+            arr = arr.astype(np.float32)  # no native np.isfinite; upcast is
+            # exact for these narrow types. Real f/c dtypes are NOT cast:
+            # float64 would overflow and complex would drop its imag part.
         if not np.isfinite(arr).all():
             n = int((~np.isfinite(arr)).sum())
             bad.append(f"{_path_str(path)} ({n}/{arr.size} non-finite)")
