@@ -38,6 +38,10 @@ class TransformerConfig:
     # MoE: 0 experts = dense MLP everywhere; >0 = MoE MLP in every block
     n_experts: int = 0
     capacity_factor: float = 2.0
+    # rematerialize each block's activations in backward (jax.checkpoint):
+    # trades recompute FLOPs for O(n_layers) less activation memory — the
+    # TPU-first long-context memory lever (HBM, not sequence sharding)
+    remat: bool = False
 
 
 class SelfAttention(nn.Module):
@@ -108,8 +112,9 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype, name="pos_emb")(
             positions
         )
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.attention_fn, self.mlp_cls, name=f"block{i}")(x)
+            x = block_cls(cfg, self.attention_fn, self.mlp_cls, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
         return jnp.asarray(logits, jnp.float32)

@@ -93,8 +93,17 @@ class Heartbeat:
 
     def stop(self, *, deregister: bool = False) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            if t.is_alive():
+                # beat thread is wedged inside a native request (store not
+                # answering). Closing its fd now would race OS fd reuse and
+                # let the unwedged thread corrupt a foreign connection, so
+                # leave the clone open and keep _thread set — start() stays
+                # a no-op and the thread exits on its own once it unblocks
+                # (the stop event is already set).
+                return
             self._thread = None
         if deregister:
             try:
