@@ -22,6 +22,11 @@ IMAGE_SHAPE = [3000, 3000]
 def train(device_index, args):
     import jax
 
+    if args.batch_size % args.accum_steps:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must be divisible by "
+            f"--accum-steps {args.accum_steps}"
+        )
     if args.force_cpu:
         from tpu_sandbox.utils.cli import ensure_devices
 
@@ -51,16 +56,20 @@ def train(device_index, args):
 
     # reference :55-59: shuffle=True, num_workers=0. --native-loader swaps in
     # the C++ worker-pool loader (gather+normalize off the Python thread).
+    # accumulation needs every batch divisible into microbatches: drop the
+    # ragged tail instead of crashing on it at the end of an epoch
+    drop_last = args.accum_steps > 1
     if args.native_loader:
         from tpu_sandbox.data.native_loader import NativeBatchLoader
 
         loader = NativeBatchLoader(
-            images, labels, args.batch_size, shuffle=True, seed=0, threads=2
+            images, labels, args.batch_size, shuffle=True, seed=0, threads=2,
+            drop_last=drop_last,
         )
     else:
         loader = BatchLoader(
             normalize(images), labels.astype("int32"), args.batch_size,
-            shuffle=True, seed=0,
+            shuffle=True, seed=0, drop_last=drop_last,
         )
 
     state = TrainState.create(
@@ -72,7 +81,8 @@ def train(device_index, args):
         if ckpt.latest_step(args.ckpt_dir) is not None:
             state = ckpt.restore(args.ckpt_dir, state)
             print(f"resumed from step {int(state.step)}")
-    step = make_train_step(model, tx, image_size=tuple(image_shape))
+    step = make_train_step(model, tx, image_size=tuple(image_shape),
+                           accum_steps=args.accum_steps)
     trainer = Trainer(step, log_every=args.log_every)
     state = trainer.fit(state, loader, args.epochs)
     if args.ckpt_dir:
@@ -93,6 +103,11 @@ def main():
     parser.add_argument("--limit-steps", type=int, default=None,
                         help="cap steps per epoch (quick runs)")
     parser.add_argument("--log-every", type=int, default=100)
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient accumulation: split each batch into k "
+                             "sequential microbatches (OOM workaround on ONE "
+                             "device — the counterpart of the reference's "
+                             "DDP batch split, README OOM experiment)")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16",
                         help="compute dtype; params and loss stay fp32")
     parser.add_argument("--native-loader", action="store_true",

@@ -82,3 +82,44 @@ def test_eval_step_counts_correct():
     correct, loss = eval_step(state, normalize(images), labels.astype("int32"))
     assert float(correct) / 64 > 0.5  # learnable prototypes: well above chance
     assert np.isfinite(float(loss))
+
+
+def test_grad_accumulation_matches_full_batch():
+    """Without BN, k accumulated microbatches == one full-batch step exactly
+    (mean CE is the mean of equal-size microbatch means; SGD is linear)."""
+    model = ConvNet(use_bn=False)
+    tx = optax.sgd(1e-2)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((8, 32, 32, 1), dtype=np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, size=8), jnp.int32)
+
+    state0 = TrainState.create(model, jax.random.key(0), jnp.zeros((1, 32, 32, 1)), tx)
+    full = make_train_step(model, tx, donate=False)
+    acc = make_train_step(model, tx, accum_steps=4, donate=False)
+
+    s_full, loss_full = full(state0, images, labels)
+    s_acc, loss_acc = acc(state0, images, labels)
+    np.testing.assert_allclose(float(loss_full), float(loss_acc), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+        ),
+        s_full.params, s_acc.params,
+    )
+
+
+def test_grad_accumulation_with_bn_trains():
+    """With BN the two are intentionally NOT identical (per-microbatch
+    statistics, torch semantics); just check training progresses."""
+    model = ConvNet()
+    tx = optax.sgd(1e-2)
+    images, labels = synthetic_mnist(n=16, seed=0)
+    images = jnp.asarray(normalize(images))
+    labels = jnp.asarray(labels.astype("int32"))
+    state = TrainState.create(model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx)
+    step = make_train_step(model, tx, accum_steps=2, donate=False)
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, images, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

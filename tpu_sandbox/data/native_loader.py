@@ -70,6 +70,7 @@ class NativeBatchLoader:
         sampler: DistributedSampler | None = None,
         threads: int = 2,
         prefetch: int = 4,
+        drop_last: bool = False,
     ):
         if images.dtype != np.uint8 or labels.dtype != np.uint8:
             raise TypeError(
@@ -90,6 +91,7 @@ class NativeBatchLoader:
         self.sampler = sampler
         self.threads = threads
         self.prefetch = prefetch
+        self.drop_last = drop_last
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -97,17 +99,23 @@ class NativeBatchLoader:
 
     def _indices(self) -> np.ndarray:
         if self.sampler is not None:
-            return self.sampler.indices(self.epoch).astype(np.int64)
-        if self.shuffle:
-            return (
+            idx = self.sampler.indices(self.epoch).astype(np.int64)
+        elif self.shuffle:
+            idx = (
                 np.random.default_rng(self.seed + self.epoch)
                 .permutation(len(self.images))
                 .astype(np.int64)
             )
-        return np.arange(len(self.images), dtype=np.int64)
+        else:
+            idx = np.arange(len(self.images), dtype=np.int64)
+        if self.drop_last:
+            idx = idx[: len(idx) - len(idx) % self.batch_size]
+        return idx
 
     def __len__(self) -> int:
         n = self.sampler.per_replica if self.sampler is not None else len(self.images)
+        if self.drop_last:
+            return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
     def __iter__(self):

@@ -38,15 +38,34 @@ def make_train_step(
     tx: optax.GradientTransformation,
     *,
     image_size: tuple[int, int] | None = None,
+    accum_steps: int = 1,
     donate: bool = True,
 ) -> Callable:
     """Build the jit'd (state, images, labels) -> (state, loss) step.
 
     ``image_size``: if set, inputs [N,h,w,C] are bilinearly resized to
     [N,H,W,C] on device before the forward pass.
+
+    ``accum_steps``: gradient accumulation — the batch is split into
+    ``accum_steps`` microbatches scanned sequentially; gradients are
+    averaged and ONE optimizer update is applied. This is the
+    single-device counterpart of the reference's OOM workaround (its DDP
+    splits effective batch 10 across 2 GPUs; accumulation trains the same
+    effective batch on one device with 1/k the activation memory, at k
+    sequential passes). BN statistics update per microbatch, sequentially —
+    the same semantics k torch forward passes would produce. The resize
+    also happens per microbatch, so the full-size image batch never
+    materializes at once.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def loss_fn(params, batch_stats, images, labels):
+        if image_size is not None:
+            n, _, _, c = images.shape
+            images = jax.image.resize(
+                images, (n, *image_size, c), method="bilinear"
+            )
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
@@ -55,16 +74,39 @@ def make_train_step(
         )
         return cross_entropy_loss(logits, labels), mutated.get("batch_stats", {})
 
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, images: jax.Array, labels: jax.Array):
-        if image_size is not None:
-            n, _, _, c = images.shape
-            images = jax.image.resize(
-                images, (n, *image_size, c), method="bilinear"
+        if accum_steps == 1:
+            (loss, new_stats), grads = grad_fn(
+                state.params, state.batch_stats, images, labels
             )
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, images, labels
-        )
+        else:
+            n = images.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch {n} not divisible by accum_steps {accum_steps}"
+                )
+            micro = n // accum_steps
+            m_images = images.reshape(accum_steps, micro, *images.shape[1:])
+            m_labels = labels.reshape(accum_steps, micro, *labels.shape[1:])
+
+            def body(carry, mb):
+                grads_acc, loss_acc, stats = carry
+                (loss, stats), grads = grad_fn(
+                    state.params, stats, mb[0], mb[1]
+                )
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + loss, stats), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss, new_stats), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32), state.batch_stats),
+                (m_images, m_labels),
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = loss / accum_steps
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (
