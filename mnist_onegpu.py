@@ -22,6 +22,8 @@ IMAGE_SHAPE = [3000, 3000]
 def train(device_index, args):
     import jax
 
+    if args.accum_steps < 1:
+        raise SystemExit(f"--accum-steps must be >= 1, got {args.accum_steps}")
     if args.batch_size % args.accum_steps:
         raise SystemExit(
             f"--batch-size {args.batch_size} must be divisible by "
@@ -84,7 +86,43 @@ def train(device_index, args):
     step = make_train_step(model, tx, image_size=tuple(image_shape),
                            accum_steps=args.accum_steps)
     trainer = Trainer(step, log_every=args.log_every)
-    state = trainer.fit(state, loader, args.epochs)
+    import contextlib
+
+    if args.profile:
+        from tpu_sandbox.utils.profiling import trace
+
+        profile_ctx = trace(args.profile)
+    else:
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        state = trainer.fit(state, loader, args.epochs)
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
+    if args.eval:
+        from tpu_sandbox.train.trainer import make_eval_step
+
+        try:
+            eval_images, eval_labels = load_mnist("test", args.data_dir)
+        except FileNotFoundError:
+            eval_images, eval_labels = synthetic_mnist(n=2000, seed=1)
+        eval_images = normalize(eval_images)
+        eval_labels = eval_labels.astype("int32")
+        eval_step = make_eval_step(model, image_size=tuple(image_shape))
+        ebs = min(args.batch_size, len(eval_images))
+        correct = total = batches = 0
+        loss_sum = 0.0
+        for i in range(0, len(eval_images) - ebs + 1, ebs):
+            c, l = eval_step(state, eval_images[i:i + ebs],
+                             eval_labels[i:i + ebs])
+            correct += int(c)
+            loss_sum += float(l)
+            total += ebs
+            batches += 1
+        if total:
+            print(f"Eval: accuracy {correct}/{total} = {correct / total:.4f}, "
+                  f"mean loss {loss_sum / batches:.4f}")
+        else:
+            print("Eval: no test data available, skipped")
     if args.ckpt_dir:
         from tpu_sandbox.train import checkpoint as ckpt
 
@@ -114,6 +152,11 @@ def main():
                         help="use the C++ prefetching data loader")
     parser.add_argument("--ckpt-dir", type=str, default=None,
                         help="save a checkpoint here after training")
+    parser.add_argument("--profile", type=str, default=None, metavar="DIR",
+                        help="capture a jax.profiler trace of training into "
+                             "DIR (view in TensorBoard/Perfetto)")
+    parser.add_argument("--eval", action="store_true",
+                        help="report test-set accuracy after training")
     parser.add_argument("--resume", action="store_true",
                         help="restore the latest checkpoint from --ckpt-dir first")
     parser.add_argument("--force-cpu", action="store_true",

@@ -106,3 +106,88 @@ def test_numerical_parity_with_torch_reference():
     with torch.no_grad():
         torch_out = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
     np.testing.assert_allclose(jax_out, torch_out, atol=1e-4)
+
+
+def _torch_twin(torch, params, hw=4):
+    """Torch replica of the reference stack with weights copied from flax
+    params (shared by the forward-parity and loss-curve-parity tests)."""
+    tnn = torch.nn
+
+    class TorchNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layer1 = tnn.Sequential(
+                tnn.Conv2d(1, 16, 5, stride=1, padding=2),
+                tnn.BatchNorm2d(16), tnn.ReLU(), tnn.MaxPool2d(2, 2))
+            self.layer2 = tnn.Sequential(
+                tnn.Conv2d(16, 32, 5, stride=1, padding=2),
+                tnn.BatchNorm2d(32), tnn.ReLU(), tnn.MaxPool2d(2, 2))
+            self.fc = tnn.Linear(32 * hw * hw, 10)
+
+        def forward(self, x):
+            x = self.layer2(self.layer1(x))
+            return self.fc(x.reshape(x.shape[0], -1))
+
+    tm = TorchNet()
+    with torch.no_grad():
+        for i, layer in enumerate([tm.layer1, tm.layer2], start=1):
+            k = np.asarray(params[f"conv{i}"]["kernel"]).transpose(3, 2, 0, 1).copy()
+            layer[0].weight.copy_(torch.from_numpy(k))
+            layer[0].bias.copy_(torch.from_numpy(
+                np.asarray(params[f"conv{i}"]["bias"]).copy()))
+            layer[1].weight.copy_(torch.from_numpy(
+                np.asarray(params[f"bn{i}"]["scale"]).copy()))
+            layer[1].bias.copy_(torch.from_numpy(
+                np.asarray(params[f"bn{i}"]["bias"]).copy()))
+        fck = np.asarray(params["fc"]["kernel"])
+        fck_hwc = (fck.reshape(hw, hw, 32, 10)
+                   .transpose(2, 0, 1, 3).reshape(32 * hw * hw, 10))
+        tm.fc.weight.copy_(torch.from_numpy(fck_hwc.T.copy()))
+        tm.fc.bias.copy_(torch.from_numpy(np.asarray(params["fc"]["bias"]).copy()))
+    return tm
+
+
+def test_training_loss_curve_parity_with_torch():
+    """SURVEY §7 hard-part 3: same init, same data, same SGD — the per-step
+    *training* losses must track the torch reference step for step (train
+    mode exercises conv/BN/pool/matmul gradients and the BN batch-stat
+    path; SGD(lr, no momentum) is linear so drift would compound and show)."""
+    torch = pytest.importorskip("torch")
+    import optax
+
+    from tpu_sandbox.train import TrainState, make_train_step
+
+    lr, steps, bs = 0.05, 8, 8
+    model, variables = init_model(16, 16)
+    tm = _torch_twin(torch, variables["params"], hw=4)
+
+    rng = np.random.default_rng(42)
+    batches = [
+        (rng.normal(size=(bs, 16, 16, 1)).astype(np.float32),
+         rng.integers(0, 10, size=bs).astype(np.int64))
+        for _ in range(steps)
+    ]
+
+    tx = optax.sgd(lr)
+    state = TrainState.create(model, jax.random.key(0), jnp.zeros((1, 16, 16, 1)), tx)
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    step = make_train_step(model, tx, donate=False)
+    jax_losses = []
+    for x, y in batches:
+        state, loss = step(state, jnp.asarray(x), jnp.asarray(y.astype(np.int32)))
+        jax_losses.append(float(loss))
+
+    tm.train()
+    opt = torch.optim.SGD(tm.parameters(), lr=lr)
+    crit = torch.nn.CrossEntropyLoss()
+    torch_losses = []
+    for x, y in batches:
+        opt.zero_grad()
+        out = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        loss = crit(out, torch.from_numpy(y))
+        loss.backward()
+        opt.step()
+        torch_losses.append(float(loss))
+
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=2e-3, atol=2e-3)

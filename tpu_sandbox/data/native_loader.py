@@ -121,6 +121,8 @@ class NativeBatchLoader:
     def __iter__(self):
         lib = _load()
         idx = np.ascontiguousarray(self._indices())
+        if len(idx) == 0:
+            return  # drop_last on a tiny dataset: zero batches, like BatchLoader
         handle = lib.loader_create(
             self.images.ctypes.data,
             self.labels.ctypes.data,

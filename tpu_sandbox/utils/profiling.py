@@ -20,7 +20,10 @@ import jax
 @contextlib.contextmanager
 def trace(logdir: str, *, host_tracer_level: int = 2):
     """Capture an XLA profiler trace for the enclosed block."""
-    jax.profiler.start_trace(logdir, host_tracer_level=host_tracer_level)
+    try:
+        jax.profiler.start_trace(logdir, host_tracer_level=host_tracer_level)
+    except TypeError:  # newer jax: tracer options moved off start_trace
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:
