@@ -22,13 +22,31 @@ import argparse
 IMAGE_SHAPE = [3000, 3000]
 
 
+def load_training_arrays(args, world_size):
+    """Real MNIST if available, synthetic otherwise; normalized and trimmed
+    to --limit-steps (shared by the single- and multi-process paths)."""
+    from tpu_sandbox.data import load_mnist, synthetic_mnist
+    from tpu_sandbox.data.mnist import normalize
+
+    try:
+        images, labels = load_mnist("train", args.data_dir)
+    except FileNotFoundError:
+        print("MNIST IDX files not found; using deterministic synthetic MNIST")
+        images, labels = synthetic_mnist(n=args.synthetic_n, seed=0)
+    images = normalize(images)
+    labels = labels.astype("int32")
+    if args.limit_steps:
+        keep = args.limit_steps * args.batch_size * world_size
+        images, labels = images[:keep], labels[:keep]
+    return images, labels
+
+
 def train(args, world_size):
     import jax
     import jax.numpy as jnp
     import optax
 
-    from tpu_sandbox.data import ShardedBatchLoader, load_mnist, synthetic_mnist
-    from tpu_sandbox.data.mnist import normalize
+    from tpu_sandbox.data import ShardedBatchLoader
     from tpu_sandbox.models import ConvNet
     from tpu_sandbox.parallel import DataParallel
     from tpu_sandbox.runtime import bootstrap
@@ -46,16 +64,7 @@ def train(args, world_size):
     model = ConvNet(num_classes=10, dtype=dtype)
     tx = optax.sgd(learning_rate=1e-4)  # reference :65
 
-    try:
-        images, labels = load_mnist("train", args.data_dir)
-    except FileNotFoundError:
-        print("MNIST IDX files not found; using deterministic synthetic MNIST")
-        images, labels = synthetic_mnist(n=args.synthetic_n, seed=0)
-    images = normalize(images)
-    labels = labels.astype("int32")
-    if args.limit_steps:
-        keep = args.limit_steps * args.batch_size * world_size
-        images, labels = images[:keep], labels[:keep]
+    images, labels = load_training_arrays(args, world_size)
 
     # bs per rank (reference :60-61); sampler shards, loader never reshuffles
     # across epochs (reference quirk: no sampler.set_epoch, SURVEY §2.1 C14)
@@ -88,6 +97,132 @@ def train(args, world_size):
     bootstrap.cleanup()
 
 
+def train_multiprocess_worker(args, world_size):
+    """One OS process = one rank with one CPU device — the reference's
+    actual topology (one proc per GPU, mnist_distributed.py:127), over
+    jax.distributed + Gloo instead of NCCL. Each process feeds its
+    DistributedSampler shard and assembles the global batch with
+    make_array_from_process_local_data; the jit'd shard_map step then runs
+    SPMD across processes with cross-process grad pmean."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+
+    import numpy as np
+
+    from tpu_sandbox.runtime import bootstrap
+
+    bootstrap.init(
+        coordinator=f"127.0.0.1:{args.port}",
+        num_processes=world_size,
+        process_id=args.rank,
+    )
+
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_sandbox.data import BatchLoader
+    from tpu_sandbox.data.sampler import DistributedSampler
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.parallel import DataParallel
+    from tpu_sandbox.runtime.mesh import make_mesh
+    from tpu_sandbox.runtime.multihost import global_batch_from_local
+    from tpu_sandbox.train import Trainer, TrainState
+
+    rank = args.rank
+    mesh = make_mesh({"data": world_size})  # one device per process
+    image_shape = [args.image_size, args.image_size]
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+    # same seed everywhere -> same init; shard_state places it replicated
+    model = ConvNet(num_classes=10, dtype=dtype)
+    tx = optax.sgd(learning_rate=1e-4)
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros([1, *image_shape, 1], dtype), tx
+    )
+
+    images, labels = load_training_arrays(args, world_size)
+    sampler = DistributedSampler(len(images), world_size, rank, seed=0)
+    local_loader = BatchLoader(images, labels, args.batch_size,
+                               sampler=sampler, drop_last=True)
+
+    class GlobalLoader:
+        """Each process contributes its sampler shard; batches come out as
+        global process-spanning arrays (make_array_from_process_local_data)."""
+
+        def __len__(self):
+            return len(local_loader)
+
+        def set_epoch(self, epoch):
+            local_loader.set_epoch(epoch)
+
+        def __iter__(self):
+            for imgs, labs in local_loader:
+                yield (
+                    global_batch_from_local(mesh, np.asarray(imgs)),
+                    global_batch_from_local(mesh, np.asarray(labs)),
+                )
+
+    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape))
+    dstate = dp.shard_state(state)
+    trainer = Trainer(dp.train_step, log_every=args.log_every, log_rank=0,
+                      verbose=rank == 0)
+    trainer.fit(dstate, GlobalLoader(), args.epochs, set_epoch=False)
+    bootstrap.cleanup()
+
+
+def spawn_multiprocess(args, world_size):
+    import subprocess
+    import sys
+    import time
+
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+
+    if args.ckpt_dir or args.resume:
+        # orbax multi-controller checkpointing needs coordinated commits;
+        # refuse loudly rather than silently not saving
+        raise SystemExit(
+            "--ckpt-dir/--resume are not supported with --multiprocess yet; "
+            "run the single-process engine (-g N) for checkpointed training"
+        )
+    port = find_free_port()
+    cmd_base = [sys.executable, __file__, "--worker", "--port", port]
+    passthrough = [
+        "-n", str(args.nodes), "-g", str(args.gpus),
+        "--epochs", str(args.epochs), "--batch-size", str(args.batch_size),
+        "--image-size", str(args.image_size),
+        "--synthetic-n", str(args.synthetic_n),
+        "--log-every", str(args.log_every), "--dtype", args.dtype,
+    ]
+    if args.data_dir:
+        passthrough += ["--data-dir", args.data_dir]
+    if args.limit_steps:
+        passthrough += ["--limit-steps", str(args.limit_steps)]
+    procs = [
+        subprocess.Popen(cmd_base + ["--rank", str(r)] + passthrough)
+        for r in range(world_size)
+    ]
+    # fail fast: a dead worker leaves its peers blocked in a collective, so
+    # on the first nonzero exit kill the survivors (the reference's mp.spawn
+    # does the same)
+    codes = [None] * world_size
+    while any(c is None for c in codes):
+        for i, p in enumerate(procs):
+            if codes[i] is None:
+                codes[i] = p.poll()
+        if any(c not in (None, 0) for c in codes):
+            for i, p in enumerate(procs):
+                if codes[i] is None:
+                    p.terminate()
+            for p in procs:
+                p.wait(timeout=30)
+            raise SystemExit(f"worker exit codes: {[p.poll() for p in procs]}")
+        time.sleep(0.2)
+    if any(codes):
+        raise SystemExit(f"worker exit codes: {codes}")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("-n", "--nodes", type=int, default=1, metavar="N",
@@ -112,9 +247,20 @@ def main():
                         help="restore the latest checkpoint before training")
     parser.add_argument("--force-cpu", action="store_true",
                         help="use virtual CPU devices even if an accelerator is present")
+    parser.add_argument("--multiprocess", action="store_true",
+                        help="one OS process per rank over jax.distributed + "
+                             "Gloo (the reference's actual topology)")
+    parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    parser.add_argument("--port", type=str, default="", help=argparse.SUPPRESS)
     args = parser.parse_args()
     world_size = args.gpus * args.nodes  # reference :123
-    train(args, world_size)
+    if args.worker:
+        train_multiprocess_worker(args, world_size)
+    elif args.multiprocess:
+        spawn_multiprocess(args, world_size)
+    else:
+        train(args, world_size)
 
 
 if __name__ == "__main__":

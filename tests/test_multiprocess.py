@@ -38,3 +38,25 @@ def test_entry_script_multiprocess_rendezvous():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "successful test_setup!" in proc.stdout
     assert "psum check" in proc.stdout
+
+
+@pytest.mark.slow
+def test_entry_script_multiprocess_training():
+    """mnist_distributed --multiprocess: 2 OS processes train data-parallel
+    over jax.distributed/Gloo with cross-process grad pmean; the parent
+    exits 0 and rank 0 logs decreasing loss in the reference format."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "mnist_distributed.py"), "-g", "2",
+         "--multiprocess", "--epochs", "1", "--limit-steps", "6",
+         "--image-size", "64", "--batch-size", "4", "--synthetic-n", "200",
+         "--log-every", "2"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    losses = [
+        float(line.rsplit("Loss:", 1)[1])
+        for line in proc.stdout.splitlines() if "Loss:" in line
+    ]
+    assert len(losses) == 3, proc.stdout
+    assert losses[-1] < losses[0], losses
+    assert "Training complete in:" in proc.stdout

@@ -82,7 +82,13 @@ class DataParallel:
 
     def shard_state(self, state: TrainState) -> TrainState:
         """Place a single-device state on the mesh: params/opt replicated
-        (DDP's param broadcast), BN stats expanded to one copy per rank."""
+        (DDP's param broadcast), BN stats expanded to one copy per rank.
+
+        Works in multi-controller (multi-process) runs too: every process
+        must hold the same host values (same seed -> same init, exactly the
+        reference's implicit contract), and each process materializes only
+        its addressable shards via ``make_array_from_callback``.
+        """
         expanded = state.replace(
             batch_stats=jax.tree.map(
                 lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
@@ -90,11 +96,23 @@ class DataParallel:
             )
         )
         specs = self._specs(expanded)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            expanded,
-            specs,
-        )
+        if jax.process_count() == 1:
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                expanded,
+                specs,
+            )
+
+        def put(x, s):
+            import numpy as np
+
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, NamedSharding(self.mesh, s),
+                lambda idx: host[idx],
+            )
+
+        return jax.tree.map(put, expanded, specs)
 
     def unshard_state(self, state: TrainState, rank: int = 0) -> TrainState:
         """Single-device view: params as-is, rank ``rank``'s BN stats."""

@@ -168,8 +168,17 @@ class Trainer:
                 state, loss = self.train_step(state, images, labels)
                 if (i + 1) % self.log_every == 0:
                     # DP steps return per-rank losses; log rank 0's, which is
-                    # what the reference prints (mnist_distributed.py:104-106)
-                    loss_val = float(jax.numpy.ravel(loss)[0])
+                    # what the reference prints (mnist_distributed.py:104-106).
+                    # In multi-controller runs the loss array spans processes;
+                    # read this process's addressable shard instead.
+                    if (
+                        hasattr(loss, "is_fully_addressable")
+                        and not loss.is_fully_addressable
+                    ):
+                        loss_host = loss.addressable_shards[0].data
+                    else:
+                        loss_host = loss
+                    loss_val = float(jax.numpy.ravel(loss_host)[0])
                     self.losses.append(loss_val)
                     if self.verbose:
                         if self.log_rank is not None:
