@@ -113,7 +113,8 @@ def train(args):
             raise SystemExit("sp needs an even device count (data=2 x sp=n/2)")
         mesh = make_mesh({"data": 2, "sp": n // 2}, devices=devices)
         eng = SeqParallel(
-            lambda attn: TransformerLM(cfg, attention_fn=attn), tx, mesh
+            lambda attn: TransformerLM(cfg, attention_fn=attn), tx, mesh,
+            attn=args.attn,
         )
         state = eng.init_state(rng, sample)
     elif p == "pp":
@@ -169,6 +170,9 @@ def main():
                         help="pp only: GPipe microbatches per step")
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="fp32")
+    parser.add_argument("--attn", choices=["ring", "ulysses"], default="ring",
+                        help="sp only: K/V ring rotation or Ulysses "
+                             "all-to-all head/sequence swap")
     parser.add_argument("--flash", action="store_true",
                         help="use the Pallas flash-attention kernel")
     parser.add_argument("--remat", action="store_true",

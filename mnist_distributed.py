@@ -216,11 +216,14 @@ def spawn_multiprocess(args, world_size):
                 if codes[i] is None:
                     p.terminate()
             for p in procs:
-                p.wait(timeout=30)
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()  # survivor ignored SIGTERM (wedged collective)
+                    p.wait()
             raise SystemExit(f"worker exit codes: {[p.poll() for p in procs]}")
         time.sleep(0.2)
-    if any(codes):
-        raise SystemExit(f"worker exit codes: {codes}")
+    # loop exit <=> every worker finished with code 0
 
 
 def main():

@@ -99,3 +99,21 @@ def test_put_validates_leading_dim(group):
 def test_bandwidth_bench_runs(group):
     r = group.allreduce_bandwidth(nbytes=1 << 12, iters=2)
     assert r["busbw_GBps"] > 0 and r["bytes"] == (1 << 12)
+
+
+def test_all_to_all_transpose(mesh8):
+    from tpu_sandbox.parallel import CollectiveGroup
+
+    g = CollectiveGroup(mesh8, "data")
+    # rank i holds block [i]; element [i, j] must land at [j, i]
+    vals = np.arange(64, dtype=np.float32).reshape(8, 8, 1)
+    out = np.asarray(g.all_to_all(vals))
+    np.testing.assert_array_equal(out, vals.transpose(1, 0, 2))
+
+
+def test_all_to_all_rejects_bad_shape(mesh8):
+    from tpu_sandbox.parallel import CollectiveGroup
+
+    g = CollectiveGroup(mesh8, "data")
+    with pytest.raises(ValueError, match="all_to_all wants"):
+        g.all_to_all(np.zeros((8, 3)))

@@ -20,7 +20,8 @@ def _args(**over):
     base = dict(
         parallelism="dp", devices=4, steps=24, batch=4, seq_len=32, vocab=16,
         d_model=16, n_heads=2, n_layers=2, d_ff=32, lr=1e-2, microbatches=2,
-        log_every=8, dtype="fp32", flash=False, remat=False, force_cpu=False,
+        log_every=8, dtype="fp32", attn="ring", flash=False, remat=False,
+        force_cpu=False,
     )
     base.update(over)
     return argparse.Namespace(**base)

@@ -176,6 +176,36 @@ class CollectiveGroup:
         return self._shift_fn(offset)(self.put(values))
 
     @cached_property
+    def _all_to_all_fn(self):
+        # local block is [1, size, ...]; drop the sharded leading dim, trade
+        # sub-row j to rank j, restack what arrived, restore the leading dim
+        return self._smap(
+            lambda x: lax.all_to_all(
+                x[0], self.axis, split_axis=0, concat_axis=0, tiled=True
+            )[None],
+            P(self.axis),
+        )
+
+    def all_to_all(self, values) -> jax.Array:
+        """Transpose rows across ranks: rank i sends chunk j of its row-block
+        to rank j. ``values``: shape ``(size, size, ...)`` — rank i holds
+        block ``values[i]`` whose j-th sub-row goes to rank j; returns the
+        same shape with ``out[j, i] = values[i, j]``.
+
+        The primitive under expert dispatch (MoE) and Ulysses-style
+        sequence parallelism; maps to one XLA AllToAll on the ICI fabric.
+        No torch analogue in the reference (SURVEY §2.2 "EP: no all_to_all").
+        """
+        values = jnp.asarray(values)
+        if values.ndim < 2 or values.shape[0] != self.size or (
+            values.shape[1] != self.size
+        ):
+            raise ValueError(
+                f"all_to_all wants shape (size, size, ...), got {values.shape}"
+            )
+        return self._all_to_all_fn(self.put(values))
+
+    @cached_property
     def _barrier_fn(self):
         return self._smap(lambda x: lax.psum(x, self.axis), P())
 

@@ -43,6 +43,7 @@ class SeqParallel:
         *,
         data_axis: str = "data",
         seq_axis: str = "sp",
+        attn: str = "ring",
         donate: bool = True,
     ):
         for ax in (data_axis, seq_axis):
@@ -51,8 +52,19 @@ class SeqParallel:
         self.mesh = mesh
         self.data_axis, self.seq_axis = data_axis, seq_axis
         self.tx = tx
-        # the model used INSIDE shard_map: attention runs as a ring over 'sp'
-        self.sp_model = model_ctor(partial(ring_attention, axis_name=seq_axis))
+        # the model used INSIDE shard_map: attention mixes positions across
+        # the 'sp' shards — either K/V ring rotation or the Ulysses
+        # all-to-all head/sequence swap (see parallel/ulysses.py for the
+        # tradeoff between the two)
+        if attn == "ring":
+            sp_attn = partial(ring_attention, axis_name=seq_axis)
+        elif attn == "ulysses":
+            from tpu_sandbox.parallel.ulysses import ulysses_attention
+
+            sp_attn = partial(ulysses_attention, axis_name=seq_axis)
+        else:
+            raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
+        self.sp_model = model_ctor(sp_attn)
         # the same architecture with local attention (for init / eval)
         self.local_model = model_ctor(None)
         self._build(donate)

@@ -1,0 +1,83 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second of the two classic sequence-parallel attention schemes (the
+ring is in parallel/ring_attention.py; SURVEY §2.2 lists both as absent
+from the reference). Instead of rotating K/V blocks around a ring, each
+device trades its sequence shard for a head shard with ONE all-to-all:
+
+    [B, S/n, H, D]  --all_to_all(seq<->head)-->  [B, S, H/n, D]
+    full-sequence attention on the local head subset (no masks to patch:
+    every query sees the whole sequence)
+    [B, S, H/n, D]  --all_to_all(head<->seq)-->  [B, S/n, H, D]
+
+Tradeoffs vs the ring (why the framework ships both):
+- Ulysses moves activations twice per attention with all-to-all (O(S·H·D/n)
+  per device) regardless of sequence length; the ring moves K/V n-1 times
+  but overlaps each hop with compute.
+- Ulysses needs H % n == 0 (head-count bound on parallelism); the ring
+  scales to any n that divides S.
+- On TPU both map to native ICI collectives: AllToAll vs neighbor
+  ppermute. For very long S with few heads use the ring; for many-head
+  models the single all-to-all is usually cheaper.
+
+Must run inside shard_map with ``axis_name`` bound, like ring_attention;
+same ``attn(q, k, v)`` signature so models.transformer can inject either.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from tpu_sandbox.ops.attention import causal_attention
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """q,k,v: local shards [B, S/n, H, D] (inside shard_map) -> same shape."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses needs heads % ranks == 0, got H={h}, n={n} "
+            "(use ring attention for head-starved models)"
+        )
+
+    def seq_to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = causal_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+    )
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str, *, causal: bool = True):
+    """Standalone jit'd Ulysses attention over global [B, S, H, D] arrays
+    sharded on dim 1 (mirror of make_ring_attention, tested against it)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if axis not in mesh.axis_names:
+        raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+    )
+    return jax.jit(fn)
