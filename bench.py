@@ -117,8 +117,40 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
     }
 
 
+def bench_allreduce_bw(force_cpu: bool) -> dict:
+    """All-reduce bus bandwidth over all devices — the second north-star
+    metric BASELINE.md names (NCCL-style busbw accounting)."""
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    if force_cpu:
+        ensure_devices(8, force_cpu=True)
+    import jax
+
+    from tpu_sandbox.parallel.collectives import world_group
+
+    g = world_group()
+    r = g.allreduce_bandwidth()
+    result = {
+        "metric": "allreduce_bus_bandwidth",
+        "value": round(r["busbw_GBps"], 3),
+        "unit": "GB/s",
+        "vs_baseline": 0.0,  # reference published no bandwidth number
+        "algbw_GBps": round(r["algbw_GBps"], 3),
+        "payload_bytes": r["bytes"],
+        "devices": jax.device_count(),
+        "device_kind": str(jax.devices()[0].device_kind),
+    }
+    if jax.device_count() == 1:
+        # busbw = algbw * 2*(n-1)/n is identically 0 at n=1; say why
+        result["degraded"] = "single device; no interconnect to measure"
+    return result
+
+
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--metric", choices=["images_per_sec", "allreduce_bw"],
+                   default="images_per_sec",
+                   help="which benchmark to run (driver default: images/sec)")
     p.add_argument("--image-size", type=int, default=3000)
     p.add_argument("--batch-per-device", type=int, default=5)
     p.add_argument("--steps", type=int, default=20)
@@ -132,6 +164,15 @@ def main():
                    help="seconds to wait for the accelerator before falling "
                         "back to a small CPU run (0 = skip probe)")
     args = p.parse_args()
+    if args.metric == "allreduce_bw":
+        # probe-timeout 0 means "trust the environment" (same semantics as
+        # the images/sec path), not "force CPU"
+        usable = not args.probe_timeout or accelerator_usable(args.probe_timeout)
+        result = bench_allreduce_bw(force_cpu=not usable)
+        if not usable:
+            result["degraded"] = "accelerator unavailable; 8 virtual CPU devices"
+        print(json.dumps(result))
+        return
     if args.quick:
         result = bench(128, 2, 3, 1, "fp32", True, args.baseline)
     elif args.probe_timeout and not accelerator_usable(args.probe_timeout):
