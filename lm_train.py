@@ -170,9 +170,10 @@ def main():
                         help="pp only: GPipe microbatches per step")
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="fp32")
-    parser.add_argument("--attn", choices=["ring", "ulysses"], default="ring",
-                        help="sp only: K/V ring rotation or Ulysses "
-                             "all-to-all head/sequence swap")
+    parser.add_argument("--attn", choices=["ring", "ulysses", "flash_ring"],
+                        default="ring",
+                        help="sp only: jnp K/V ring, Ulysses all-to-all "
+                             "head/seq swap, or the Pallas flash-ring")
     parser.add_argument("--flash", action="store_true",
                         help="use the Pallas flash-attention kernel")
     parser.add_argument("--remat", action="store_true",

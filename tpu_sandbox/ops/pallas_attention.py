@@ -44,7 +44,8 @@ from tpu_sandbox.ops.pallas_common import (
 )
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
                 kv_len: int):
     i, j = pl.program_id(2), pl.program_id(3)
@@ -55,9 +56,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # with causal masking, kv block j contributes to q block i only when the
-    # block diagonals overlap (block_q == block_k ⇒ j <= i)
-    should_run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    # with causal masking, kv block j contributes to q block i only when
+    # the block diagonals overlap in GLOBAL positions — a runtime predicate
+    # on the prefetched offsets, so ring steps whose whole block is in the
+    # future skip both MXU matmuls instead of computing a fully-masked tile
+    should_run = True
+    if causal:
+        should_run = (
+            kv_off_ref[0, 0] + j * block_k
+            <= q_off_ref[0, 0] + (i + 1) * block_q - 1
+        )
 
     @pl.when(should_run)
     def _step():
@@ -69,13 +77,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         ) * scale                            # [block_q, block_k] fp32
 
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
+        q_pos = q_off_ref[0, 0] + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
+        k_pos = kv_off_ref[0, 0] + j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        valid = k_pos < kv_len               # mask the padded tail keys
+        valid = k_pos < kv_off_ref[0, 0] + kv_len  # mask padded tail keys
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
         s = jnp.where(valid, s, _NEG)
@@ -99,26 +107,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
+               q_offset=0, kv_offset=0, out_dtype=None):
     """q,k,v [B,H,S,D] (S multiple of blocks, D lane-aligned; ``kv_len`` is
-    the true pre-padding length) -> (out [B,H,S,D], lse [B,H,S])."""
+    the true pre-padding length) -> (out [B,H,S,D], lse [B,H,S]).
+
+    ``q_offset``/``kv_offset`` are *global* positions of the first local
+    query/key (python ints or traced scalars — ring attention passes the
+    rotating source offset); the causal block skip stays active either way
+    because the kernel predicates on the runtime offsets. ``out_dtype``
+    defaults to q's dtype; partial-attention callers pass fp32 so the
+    cross-block merge never sees a rounded partial.
+    """
     from jax.experimental.pallas import tpu as pltpu
 
     interpret = default_interpret(interpret)
     b, h, s, d = q.shape
-    grid = (b, h, s // block_q, s // block_k)
+    sk = k.shape[2]
+    grid = (b, h, s // block_q, sk // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
+    offs = [jnp.asarray(x, jnp.int32).reshape(1, 1)
+            for x in (q_offset, kv_offset)]
+    smem = functools.partial(pl.BlockSpec, (1, 1),
+                             lambda b, h, i, j: (0, 0),
+                             memory_space=pltpu.SMEM)
     return pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, s), jnp.float32),
         ),
         grid=grid,
         in_specs=[
+            smem(),
+            smem(),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
@@ -137,7 +162,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*offs, q, k, v)
 
 
 def _blockwise_bwd(q, k, v, out, lse, g, scale, causal, block_k, kv_len):
@@ -240,6 +265,52 @@ def flash_attention(
     out = _flash_core(prep(q), prep(k), prep(v), scale, causal,
                       block_q, block_k, interpret, s)
     return jnp.moveaxis(out[:, :, :s, :d], 1, 2)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward-only flash attention returning (out [B,S,H,D], lse [B,S,H]).
+
+    The partial-attention building block for ring attention: offsets give
+    queries/keys their global positions, and the logsumexp output lets the
+    caller merge partials from different K/V blocks exactly
+    (parallel/flash_ring.py). NOT differentiable on its own — the ring
+    defines the custom VJP at its own level.
+    """
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / float(d) ** 0.5
+    sp = _round_up(max(s, block_q), block_q)
+    skp = _round_up(max(sk, block_k), block_k)
+    dp = _round_up(d, _LANE)
+
+    def prep(x, target):
+        x = jnp.moveaxis(x, 2, 1)  # [B, H, S, D]
+        return jnp.pad(
+            x, ((0, 0), (0, 0), (0, target - x.shape[2]), (0, dp - d))
+        )
+
+    qp, kp, vp = prep(q, sp), prep(k, skp), prep(v, skp)
+    # padded q rows also run; their garbage rows are sliced off below, and
+    # the grid only needs square-compatible blocks, not equal q/kv lengths.
+    # fp32 partials: the caller's logsumexp merge must not see bf16 rounding
+    out, lse = _flash_fwd(qp, kp, vp, scale, causal, block_q, block_k,
+                          interpret, sk, q_offset=q_offset,
+                          kv_offset=kv_offset, out_dtype=jnp.float32)
+    return (
+        jnp.moveaxis(out[:, :, :s, :d], 1, 2),
+        jnp.moveaxis(lse[:, :, :s], 1, 2),  # [B, S, H]
+    )
 
 
 def flash_attention_fn(

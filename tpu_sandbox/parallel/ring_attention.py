@@ -31,6 +31,15 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def varying(x, axis_name):
+    """Mark an array device-varying over ``axis_name`` for jax's VMA typing
+    (pcast on newer jax, pvary fallback). Shared by both ring variants."""
+    try:
+        return lax.pcast(x, axis_name, to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(x, axis_name)
+
+
 def _online_update(carry, kv_block, q, src_index, *, local_len, causal):
     """Accumulate one arriving K/V block into the online-softmax state."""
     o, m, l, q_offset = carry
@@ -73,15 +82,9 @@ def ring_attention(
 
     # the accumulators are device-varying state: jax's VMA typing needs the
     # initial zeros cast as such or the fori_loop carry types mismatch
-    def varying(x):
-        try:
-            return lax.pcast(x, axis_name, to="varying")
-        except (AttributeError, TypeError):
-            return lax.pvary(x, axis_name)
-
-    o = varying(jnp.zeros((b, h, s_local, d), jnp.float32))
-    m = varying(jnp.full((b, h, s_local), -jnp.inf, jnp.float32))
-    l = varying(jnp.zeros((b, h, s_local), jnp.float32))
+    o = varying(jnp.zeros((b, h, s_local, d), jnp.float32), axis_name)
+    m = varying(jnp.full((b, h, s_local), -jnp.inf, jnp.float32), axis_name)
+    l = varying(jnp.zeros((b, h, s_local), jnp.float32), axis_name)
 
     # neighbor ring: shift K/V to rank+1 each step, so at step j we hold the
     # block that originated at rank (idx - j) mod n

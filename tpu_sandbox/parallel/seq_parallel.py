@@ -62,8 +62,15 @@ class SeqParallel:
             from tpu_sandbox.parallel.ulysses import ulysses_attention
 
             sp_attn = partial(ulysses_attention, axis_name=seq_axis)
+        elif attn == "flash_ring":
+            from tpu_sandbox.parallel.flash_ring import flash_ring_attention
+
+            def sp_attn(q, k, v):
+                return flash_ring_attention(q, k, v, seq_axis)
         else:
-            raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
+            raise ValueError(
+                f"attn must be 'ring', 'ulysses' or 'flash_ring', got {attn!r}"
+            )
         self.sp_model = model_ctor(sp_attn)
         # the same architecture with local attention (for init / eval)
         self.local_model = model_ctor(None)
