@@ -85,7 +85,9 @@ def train(args, world_size):
     def step(s, images_np, labels_np):
         return dp.train_step(s, *dp.shard_batch(images_np, labels_np))
 
-    trainer = Trainer(step, log_every=args.log_every, log_rank=0)
+    trainer = Trainer(step, log_every=args.log_every, log_rank=0,
+                      ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+                      state_for_checkpoint=dp.unshard_state)
     dstate = trainer.fit(dstate, loader, args.epochs, set_epoch=False)
     if args.ckpt_dir:
         from tpu_sandbox.train import checkpoint as ckpt
@@ -244,6 +246,8 @@ def main():
     parser.add_argument("--limit-steps", type=int, default=None)
     parser.add_argument("--log-every", type=int, default=100)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
+                        help="with --ckpt-dir: also save every N steps")
     parser.add_argument("--ckpt-dir", type=str, default=None,
                         help="orbax checkpoint dir (save at end of training)")
     parser.add_argument("--resume", action="store_true",

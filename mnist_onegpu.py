@@ -85,7 +85,8 @@ def train(device_index, args):
             print(f"resumed from step {int(state.step)}")
     step = make_train_step(model, tx, image_size=tuple(image_shape),
                            accum_steps=args.accum_steps)
-    trainer = Trainer(step, log_every=args.log_every)
+    trainer = Trainer(step, log_every=args.log_every,
+                      ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every)
     import contextlib
 
     if args.profile:
@@ -150,6 +151,9 @@ def main():
                         help="compute dtype; params and loss stay fp32")
     parser.add_argument("--native-loader", action="store_true",
                         help="use the C++ prefetching data loader")
+    parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
+                        help="with --ckpt-dir: also save every N steps "
+                             "(crash recovery), not just at the end")
     parser.add_argument("--ckpt-dir", type=str, default=None,
                         help="save a checkpoint here after training")
     parser.add_argument("--profile", type=str, default=None, metavar="DIR",

@@ -123,3 +123,15 @@ def test_grad_accumulation_with_bn_trains():
         state, loss = step(state, images, labels)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_periodic_checkpointing(tmp_path):
+    from tpu_sandbox.train import checkpoint as ckpt
+
+    model, state, step_fn, loader = make_setup(n=64)
+    trainer = Trainer(step_fn, log_every=100, verbose=False,
+                      ckpt_dir=str(tmp_path), ckpt_every=3)
+    trainer.fit(state, loader, epochs=1)  # 64/16 = 4 steps -> save at 3
+    assert ckpt.latest_step(tmp_path) == 3
+    restored = ckpt.restore(tmp_path, state)
+    assert int(restored.step) == 3

@@ -26,7 +26,6 @@ reference in tests.
 
 from __future__ import annotations
 
-import functools
 from functools import partial
 
 import jax
@@ -35,9 +34,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_sandbox.ops.pallas_attention import flash_attention_lse
+from tpu_sandbox.ops.pallas_common import NEG as _NEG
 from tpu_sandbox.parallel.ring_attention import varying as _varying
-
-_NEG = -1e30
 
 
 def _merge(o, lse, o_b, lse_b):

@@ -42,6 +42,34 @@ def latest_step(directory: str | os.PathLike) -> int | None:
         return mgr.latest_step()
 
 
+class AsyncSaver:
+    """Keep one manager open and save WITHOUT blocking the training loop —
+    orbax writes in the background while subsequent steps run. ``close()``
+    (or exiting the context) waits for outstanding writes."""
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                create=True, max_to_keep=max_to_keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, state: TrainState, step: int) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def restore(
     directory: str | os.PathLike, template: TrainState, step: int | None = None
 ) -> TrainState:

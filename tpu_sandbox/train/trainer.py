@@ -148,12 +148,38 @@ class Trainer:
         log_every: int = 100,
         log_rank: int | None = None,
         verbose: bool = True,
+        ckpt_dir: str | None = None,
+        ckpt_every: int = 0,
+        state_for_checkpoint: Callable | None = None,
     ):
+        """``ckpt_every`` > 0 (with ``ckpt_dir``) saves every N optimizer
+        steps — the crash-recovery companion of the watchdog subsystem (the
+        reference trains fire-and-forget; a dead run loses everything).
+        ``state_for_checkpoint`` maps the live (possibly engine-sharded)
+        state to the layout to save, e.g. DataParallel.unshard_state."""
         self.train_step = train_step
         self.log_every = log_every
         self.log_rank = log_rank  # None: single-device format; int: DDP format
         self.verbose = verbose
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = ckpt_every if ckpt_dir else 0
+        self.state_for_checkpoint = state_for_checkpoint or (lambda s: s)
+        self._saver = None
         self.losses: list[float] = []
+
+    def _maybe_checkpoint(self, state, opt_step: int) -> None:
+        """``opt_step`` is a host-side counter (each train_step increments
+        state.step by one) — reading state.step here would sync the device
+        every step and kill host/device overlap."""
+        if not self.ckpt_every or opt_step % self.ckpt_every:
+            return
+        if self._saver is None:
+            from tpu_sandbox.train.checkpoint import AsyncSaver
+
+            self._saver = AsyncSaver(self.ckpt_dir)
+        self._saver.save(self.state_for_checkpoint(state), opt_step)
+        if self.verbose:
+            print(f"checkpoint saved at step {opt_step}")
 
     def fit(self, state: TrainState, loader, epochs: int, *, set_epoch: bool = False):
         """Run ``epochs`` epochs. ``set_epoch=False`` reproduces the
@@ -161,11 +187,14 @@ class Trainer:
         (no ``sampler.set_epoch``, SURVEY §2.1 C14)."""
         start = time.monotonic()
         total_step = len(loader)
+        opt_step = int(jax.numpy.ravel(state.step)[0])  # resume-safe seed
         for epoch in range(epochs):
             if set_epoch:
                 loader.set_epoch(epoch)
             for i, (images, labels) in enumerate(loader):
                 state, loss = self.train_step(state, images, labels)
+                opt_step += 1
+                self._maybe_checkpoint(state, opt_step)
                 if (i + 1) % self.log_every == 0:
                     # DP steps return per-rank losses; log rank 0's, which is
                     # what the reference prints (mnist_distributed.py:104-106).
@@ -195,6 +224,9 @@ class Trainer:
                                 )
                             )
         jax.block_until_ready(state)
+        if self._saver is not None:
+            self._saver.close()  # drain in-flight async checkpoint writes
+            self._saver = None
         self.elapsed = timedelta(seconds=time.monotonic() - start)
         if self.verbose:
             print("Training complete in: " + str(self.elapsed))
