@@ -113,7 +113,18 @@ def train_multiprocess_worker(args, world_size):
 
     import numpy as np
 
-    from tpu_sandbox.runtime import bootstrap
+    from tpu_sandbox.runtime import Heartbeat, bootstrap, wait_for_world
+    from tpu_sandbox.runtime.kvstore import KVClient
+
+    # health plane: beat into the parent's KV store for the whole run and
+    # rendezvous with a deadline BEFORE touching jax.distributed, so a rank
+    # that never starts fails fast with names instead of hanging the group
+    # (the reference's failure mode — SURVEY §5)
+    hb = None
+    if args.kv_port:
+        kv = KVClient(port=int(args.kv_port))
+        hb = Heartbeat(kv, args.rank, interval=1.0).start()
+        wait_for_world(kv, world_size, args.rank, timeout=120.0)
 
     bootstrap.init(
         coordinator=f"127.0.0.1:{args.port}",
@@ -172,6 +183,8 @@ def train_multiprocess_worker(args, world_size):
                       verbose=rank == 0)
     trainer.fit(dstate, GlobalLoader(), args.epochs, set_epoch=False)
     bootstrap.cleanup()
+    if hb is not None:
+        hb.stop(deregister=True)
 
 
 def spawn_multiprocess(args, world_size):
@@ -188,8 +201,13 @@ def spawn_multiprocess(args, world_size):
             "--ckpt-dir/--resume are not supported with --multiprocess yet; "
             "run the single-process engine (-g N) for checkpointed training"
         )
+    from tpu_sandbox.runtime import Watchdog
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+    kv_server = KVServer()
     port = find_free_port()
-    cmd_base = [sys.executable, __file__, "--worker", "--port", port]
+    cmd_base = [sys.executable, __file__, "--worker", "--port", port,
+                "--kv-port", str(kv_server.port)]
     passthrough = [
         "-n", str(args.nodes), "-g", str(args.gpus),
         "--epochs", str(args.epochs), "--batch-size", str(args.batch_size),
@@ -205,6 +223,33 @@ def spawn_multiprocess(args, world_size):
         subprocess.Popen(cmd_base + ["--rank", str(r)] + passthrough)
         for r in range(world_size)
     ]
+    # health plane: workers heartbeat into our KV store; the watchdog
+    # catches the wedged-not-dead case (a rank alive as a process but
+    # silent for >60s — e.g. stuck in a collective whose peer vanished)
+    # that exit-code polling alone can never see
+    import os
+
+    watchdog = Watchdog(
+        KVClient(port=kv_server.port), world_size,
+        timeout=float(os.environ.get("TPU_SANDBOX_WATCHDOG_TIMEOUT", 60.0)),
+        grace=float(os.environ.get("TPU_SANDBOX_WATCHDOG_GRACE", 180.0)),
+    )
+
+    def _kill_all(reason: str):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()  # survivor ignored SIGTERM (wedged collective)
+                p.wait()
+        kv_server.stop()
+        raise SystemExit(
+            f"{reason}; worker exit codes: {[p.poll() for p in procs]}"
+        )
+
     # fail fast: a dead worker leaves its peers blocked in a collective, so
     # on the first nonzero exit kill the survivors (the reference's mp.spawn
     # does the same)
@@ -214,18 +259,15 @@ def spawn_multiprocess(args, world_size):
             if codes[i] is None:
                 codes[i] = p.poll()
         if any(c not in (None, 0) for c in codes):
-            for i, p in enumerate(procs):
-                if codes[i] is None:
-                    p.terminate()
-            for p in procs:
-                try:
-                    p.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    p.kill()  # survivor ignored SIGTERM (wedged collective)
-                    p.wait()
-            raise SystemExit(f"worker exit codes: {[p.poll() for p in procs]}")
+            _kill_all("worker failure detected")
+        # only ranks whose PROCESS is still running count: a cleanly-exited
+        # rank deregisters its heartbeat and must not read as dead
+        dead = [r for r in watchdog.dead_ranks() if codes[r] is None]
+        if dead:
+            _kill_all(f"watchdog: rank(s) {dead} stopped heartbeating")
         time.sleep(0.2)
     # loop exit <=> every worker finished with code 0
+    kv_server.stop()
 
 
 def main():
@@ -260,6 +302,8 @@ def main():
     parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
     parser.add_argument("--port", type=str, default="", help=argparse.SUPPRESS)
+    parser.add_argument("--kv-port", type=str, default="",
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
     world_size = args.gpus * args.nodes  # reference :123
     if args.worker:

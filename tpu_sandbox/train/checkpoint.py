@@ -56,8 +56,11 @@ class AsyncSaver:
             ),
         )
 
-    def save(self, state: TrainState, step: int) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, state: TrainState, step: int) -> bool:
+        """Returns False when orbax declined the save (e.g. the directory
+        already holds a step >= ``step`` from an earlier run) — callers
+        must not report success in that case."""
+        return bool(self._mgr.save(step, args=ocp.args.StandardSave(state)))
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

@@ -177,9 +177,15 @@ class Trainer:
             from tpu_sandbox.train.checkpoint import AsyncSaver
 
             self._saver = AsyncSaver(self.ckpt_dir)
-        self._saver.save(self.state_for_checkpoint(state), opt_step)
-        if self.verbose:
-            print(f"checkpoint saved at step {opt_step}")
+        if self._saver.save(self.state_for_checkpoint(state), opt_step):
+            if self.verbose:
+                print(f"checkpoint saved at step {opt_step}")
+        elif self.verbose:
+            print(
+                f"checkpoint SKIPPED at step {opt_step}: {self.ckpt_dir} "
+                "already holds a later step (stale dir from a previous run? "
+                "pass --resume or a fresh --ckpt-dir)"
+            )
 
     def fit(self, state: TrainState, loader, epochs: int, *, set_epoch: bool = False):
         """Run ``epochs`` epochs. ``set_epoch=False`` reproduces the
@@ -188,6 +194,24 @@ class Trainer:
         start = time.monotonic()
         total_step = len(loader)
         opt_step = int(jax.numpy.ravel(state.step)[0])  # resume-safe seed
+        try:
+            state = self._run_epochs(state, loader, epochs, set_epoch,
+                                     total_step, opt_step)
+        finally:
+            if self._saver is not None:
+                # drain in-flight async writes even when the loop raised —
+                # an abandoned background save is an orphaned tmp dir, i.e.
+                # a lost crash-recovery checkpoint
+                self._saver.close()
+                self._saver = None
+        jax.block_until_ready(state)
+        self.elapsed = timedelta(seconds=time.monotonic() - start)
+        if self.verbose:
+            print("Training complete in: " + str(self.elapsed))
+        return state
+
+    def _run_epochs(self, state, loader, epochs, set_epoch, total_step,
+                    opt_step):
         for epoch in range(epochs):
             if set_epoch:
                 loader.set_epoch(epoch)
@@ -223,11 +247,4 @@ class Trainer:
                                     epoch + 1, epochs, i + 1, total_step, loss_val
                                 )
                             )
-        jax.block_until_ready(state)
-        if self._saver is not None:
-            self._saver.close()  # drain in-flight async checkpoint writes
-            self._saver = None
-        self.elapsed = timedelta(seconds=time.monotonic() - start)
-        if self.verbose:
-            print("Training complete in: " + str(self.elapsed))
         return state
