@@ -109,6 +109,63 @@ def test_moe_transformer_trains():
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
 
 
+def test_aux_loss_wired_into_engine():
+    """VERDICT r01 weak #8: the sown load-balance loss must actually reach
+    the training objective. With lr=0 the step loss is pure objective, so
+    loss(aux_weight=w) - loss(aux_weight=0) == w * aux (aux >= ~1)."""
+    from tpu_sandbox.train import TrainState
+
+    mesh = make_mesh({"data": 8})
+    model = moe_model_ctor()
+    tx = optax.sgd(0.0)
+    tokens, targets = lm_batch()
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+    )
+    losses = {}
+    for w in (0.0, 0.5):
+        eng = PjitEngine(model, tx, mesh, task="lm", aux_weight=w, donate=False)
+        _, loss = eng.train_step(
+            eng.shard_state(state), *eng.shard_batch(tokens, targets)
+        )
+        losses[w] = float(loss)
+    # aux >= 0.99 (test_moe_forward_shape_and_aux_loss) => gap >= 0.5*0.99
+    assert losses[0.5] - losses[0.0] >= 0.49, losses
+
+
+def test_aux_loss_keeps_routing_balanced():
+    """Train a few hundred steps with the Switch alpha and assert top-1
+    routing does not collapse: balanced routing keeps aux ~= 1, collapse
+    onto one of E=4 experts drives it toward 4."""
+    from tpu_sandbox.train import TrainState
+
+    mesh = make_mesh({"data": 8})
+    model = moe_model_ctor()
+    tx = optax.adam(3e-3)
+    tokens, targets = lm_batch(b=8, s=16)
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+    )
+    eng = PjitEngine(model, tx, mesh, task="lm", aux_weight=0.01, donate=False)
+    state = eng.shard_state(state)
+    batch = eng.shard_batch(tokens, targets)
+    first = None
+    for i in range(200):
+        state, loss = eng.train_step(state, *batch)
+        if first is None:
+            first = float(loss)
+        elif i % 20 == 0:
+            float(loss)  # sync: cap the async dispatch queue
+
+    _, sown = model.apply(
+        {"params": jax.device_get(state.params)}, jnp.asarray(tokens),
+        mutable=["aux_loss"],
+    )
+    aux = float(jax.tree.leaves(sown["aux_loss"])[0])
+    assert aux < 1.8, f"routing collapsing: aux={aux}"
+    assert float(loss) < first, (first, float(loss))
+
+
 def test_expert_parallel_sharding_matches_unsharded():
     """dp x ep mesh: expert weights sharded on 'expert'; the jit'd step must
     produce the same loss and params as the unsharded single-device step."""

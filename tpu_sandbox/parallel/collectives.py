@@ -226,20 +226,32 @@ class CollectiveGroup:
         Returns algorithm bandwidth (payload/time) and bus bandwidth
         (algbw * 2*(n-1)/n — the standard ring-allreduce accounting, which
         is what NCCL reports for the reference's fabric).
+
+        Timing is fetch-synced and differential (see
+        utils/profiling.py::measure_per_step): each iteration's input is the
+        previous iteration's output (mean keeps values stable), so no
+        iteration can be elided, and the only trusted sync — a device->host
+        scalar fetch — ends each timed run. ``block_until_ready`` is NOT
+        used: on async-dispatch platforms (the axon TPU tunnel) it returns
+        before the device executes, which is how r01 published an unreal
+        headline number.
         """
+        from tpu_sandbox.utils.profiling import measure_per_step
+
         n = self.size
         elems = max(nbytes // 4, n)
         elems -= elems % n
         x = self.put(jnp.ones((n, elems // n), jnp.float32))
-        fn = self._all_reduce_fns["sum"]
-        fn(x).block_until_ready()  # compile + warm
-        import time
+        fn = self._all_reduce_fns["mean"]
 
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(x)
-        out.block_until_ready()
-        dt = (time.perf_counter() - t0) / iters
+        def run(k):
+            out = x
+            for _ in range(k):
+                out = fn(out)
+            return out
+
+        timing = measure_per_step(run, iters)
+        dt = timing["sec_per_step"]
         algbw = elems * 4 / dt
         busbw = algbw * (2 * (n - 1) / n)
         return {
@@ -247,6 +259,7 @@ class CollectiveGroup:
             "seconds": dt,
             "algbw_GBps": algbw / 1e9,
             "busbw_GBps": busbw / 1e9,
+            "timing_method": timing["timing_method"],
         }
 
 

@@ -106,6 +106,7 @@ class PjitEngine:
         input_spec: P | None = None,
         image_size: tuple[int, int] | None = None,
         task: str = "image",
+        aux_weight: float = 0.01,
         donate: bool = True,
     ):
         if task not in ("image", "lm"):
@@ -125,6 +126,11 @@ class PjitEngine:
         self.input_spec = input_spec if input_spec is not None else P(batch_axis)
         self.image_size = image_size
         self.task = task
+        # Weight on sown "aux_loss" values (MoE load-balance, Switch eq. 4,
+        # parallel/expert.py:65): without it top-1 routing can collapse onto
+        # one expert (VERDICT r01 weak #8). 0.01 is the Switch paper's alpha;
+        # models that sow nothing are unaffected.
+        self.aux_weight = aux_weight
         self.donate = donate
         self._jitted: Callable | None = None
 
@@ -147,15 +153,19 @@ class PjitEngine:
         model, tx, image_size = self.model, self.tx, self.image_size
 
         if self.task == "lm":
+            aux_weight = self.aux_weight
 
             def loss_fn(params, batch_stats, tokens, targets):
-                logits = model.apply({"params": params}, tokens)
-                return (
-                    cross_entropy_loss(
-                        logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-                    ),
-                    batch_stats,
+                logits, sown = model.apply(
+                    {"params": params}, tokens, mutable=["aux_loss"]
                 )
+                loss = cross_entropy_loss(
+                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+                )
+                aux = jax.tree.leaves(sown.get("aux_loss", {}))
+                if aux:  # mean over layers: alpha independent of depth
+                    loss = loss + aux_weight * sum(aux) / len(aux)
+                return loss, batch_stats
 
         else:
 

@@ -37,6 +37,51 @@ def annotate(name: str):
         yield
 
 
+def host_sync(x) -> float:
+    """TRUE host-side synchronization: fetch a scalar derived from ``x``.
+
+    On some platforms (the experimental 'axon' TPU tunnel among them)
+    ``block_until_ready`` returns as soon as the dispatch is acknowledged —
+    measured at ~0.02 ms for a 100 ms computation — so wall-clock timing
+    around it reports physically impossible throughput (the r01 benchmark
+    bug: ~10x chip peak, VERDICT.md weak #1). A device->host transfer of a
+    value that data-depends on the computation cannot complete early on any
+    platform; this is the only sync primitive benchmarks here may use.
+    """
+    import jax.numpy as jnp
+
+    return float(jnp.ravel(x)[0])
+
+
+def measure_per_step(run_steps, n: int) -> dict:
+    """Fetch-synced *differential* step timing: per_step = (t(2n)-t(n)) / n.
+
+    ``run_steps(k)`` must execute k steps whose final output data-depends on
+    all k (e.g. a threaded train state) and return that output; we fetch a
+    scalar from it (``host_sync``). Timing t(n) and t(2n) and differencing
+    cancels the constant costs a single timed loop cannot escape — the
+    host->device fetch round-trip (~80 ms through the axon tunnel) and any
+    fixed dispatch overhead — leaving the marginal cost of one step.
+
+    Both loops are warmed (compile + queue drain) before timing. Returns
+    seconds per step plus the raw t(n)/t(2n) for the benchmark record.
+    """
+    host_sync(run_steps(n))  # warm: compile, stage, drain queue
+    t0 = time.perf_counter()
+    host_sync(run_steps(n))
+    t_n = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    host_sync(run_steps(2 * n))
+    t_2n = time.perf_counter() - t0
+    return {
+        "sec_per_step": (t_2n - t_n) / n,
+        "t_n_sec": t_n,
+        "t_2n_sec": t_2n,
+        "n": n,
+        "timing_method": "fetch-synced differential (t(2n)-t(n))/n",
+    }
+
+
 @dataclass
 class StepTimer:
     """Throughput measurement: call start() once, tick(n_items) per step."""
