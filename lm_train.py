@@ -60,6 +60,7 @@ def train(args):
         PipelineParallel,
         PjitEngine,
         SeqParallel,
+        megatron_rules,
     )
     from tpu_sandbox.runtime import bootstrap
     from tpu_sandbox.runtime.mesh import make_mesh
@@ -91,23 +92,22 @@ def train(args):
         state = TrainState.create(model, rng, sample, tx)
         eng = PjitEngine(model, tx, mesh, task="lm")
     elif p == "tp":
-        if args.n_heads % n or args.d_ff % n:
+        if args.dp < 1 or n % args.dp:
+            raise SystemExit(f"--dp {args.dp} must be >= 1 and divide {n} devices")
+        dp, m = args.dp, n // args.dp
+        if args.n_heads % m or args.d_ff % m or args.vocab % m or args.d_model % m:
             raise SystemExit(
-                f"tp shards heads and d_ff: --n-heads {args.n_heads} and "
-                f"--d-ff {args.d_ff} must be divisible by {n} devices"
+                f"tp shards heads, d_ff, vocab and d_model: --n-heads "
+                f"{args.n_heads}, --d-ff {args.d_ff}, --vocab {args.vocab}, "
+                f"--d-model {args.d_model} must be divisible by {m} "
+                "model-parallel ranks"
             )
-        # data axis of size 1: batch replicated, kernels sharded on 'model'
-        mesh = make_mesh({"data": 1, "model": n}, devices=devices)
+        # composes with data parallelism: batch sharded on 'data', kernels
+        # (full Megatron set incl. out-proj, lm_head, embeddings) on 'model'
+        mesh = make_mesh({"data": dp, "model": m}, devices=devices)
         model = TransformerLM(cfg, attention_fn=attention_fn)
         state = TrainState.create(model, rng, sample, tx)
-        eng = PjitEngine(
-            model, tx, mesh, task="lm",
-            rules=[
-                (r"attn/qkv/kernel", P(None, None, "model", None)),
-                (r"mlp/up/kernel", P(None, "model")),
-                (r"mlp/down/kernel", P("model", None)),
-            ],
-        )
+        eng = PjitEngine(model, tx, mesh, task="lm", rules=megatron_rules())
     elif p == "sp":
         if n % 2:
             raise SystemExit("sp needs an even device count (data=2 x sp=n/2)")
@@ -122,6 +122,22 @@ def train(args):
             raise SystemExit(f"pp needs n_layers divisible by {n} devices")
         mesh = make_mesh({"data": 1, "pipe": n}, devices=devices)
         eng = PipelineParallel(cfg, tx, mesh, microbatches=args.microbatches)
+        state = eng.init_state(rng, sample)
+    elif p == "3d":
+        # data x model x pipe: DP batch sharding, Megatron TP inside each
+        # pipeline stage, GPipe microbatching across stages
+        if n % 8:
+            raise SystemExit("3d wants devices divisible by 8 (2x2x2 mesh)")
+        shape = {"data": 2, "model": 2, "pipe": n // 4}
+        if cfg.n_layers % shape["pipe"] or args.n_heads % 2 or args.d_ff % 2:
+            raise SystemExit(
+                f"3d at {n} devices needs n_layers % {shape['pipe']} == 0 "
+                "and even --n-heads/--d-ff"
+            )
+        mesh = make_mesh(shape, devices=devices)
+        eng = PipelineParallel(
+            cfg, tx, mesh, microbatches=args.microbatches, model_axis="model"
+        )
         state = eng.init_state(rng, sample)
     elif p == "ep":
         mesh = make_mesh({"data": 1, "expert": n}, devices=devices)
@@ -154,8 +170,12 @@ def train(args):
 
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--parallelism", choices=["dp", "tp", "sp", "pp", "ep"],
+    parser.add_argument("--parallelism",
+                        choices=["dp", "tp", "sp", "pp", "ep", "3d"],
                         default="dp")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="tp only: data-parallel axis size composed "
+                             "with model parallelism (devices = dp x tp)")
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--steps", type=int, default=60)
     parser.add_argument("--batch", type=int, default=8)

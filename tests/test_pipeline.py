@@ -109,6 +109,51 @@ def test_pipeline_training_learns(mesh_dp_pp):
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
 
 
+def test_pipeline_tp_stages_match_single_device():
+    """3-axis data x model x pipe mesh: Megatron TP inside each stage must
+    reproduce the single-device step (loss and updated params)."""
+    mesh = make_mesh({"data": 2, "model": 2, "pipe": 2})
+    tx = optax.sgd(0.1)
+    pp = PipelineParallel(
+        CFG, tx, mesh, microbatches=2, model_axis="model", donate=False
+    )
+    tokens, targets = lm_batch()
+    state = pp.init_state(jax.random.key(0), jnp.asarray(tokens))
+
+    model = TransformerLM(CFG)
+    flat_params = pp.merged_params(state)
+
+    def ref_loss(params):
+        logits = model.apply({"params": params}, jnp.asarray(tokens))
+        return cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]), jnp.asarray(targets).reshape(-1)
+        )
+
+    ref_loss_val, ref_grads = jax.value_and_grad(ref_loss)(
+        jax.tree.map(jnp.asarray, flat_params)
+    )
+    ref_params = optax.apply_updates(
+        jax.tree.map(jnp.asarray, flat_params),
+        tx.update(ref_grads, tx.init(flat_params), flat_params)[0],
+    )
+
+    sstate = pp.shard_state(state)
+    qkv = sstate.params["stages"]["attn"]["qkv"]["kernel"]
+    from jax.sharding import PartitionSpec as P
+
+    assert qkv.sharding.spec == P("pipe", None, None, None, "model")
+
+    new_state, loss = pp.train_step(sstate, *pp.shard_batch(tokens, targets))
+    np.testing.assert_allclose(float(loss), float(ref_loss_val), rtol=1e-5)
+    merged_after = pp.merged_params(new_state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        merged_after, jax.tree.map(np.asarray, ref_params),
+    )
+
+
 def test_pipeline_validates(mesh_dp_pp):
     with pytest.raises(ValueError, match="divisible"):
         PipelineParallel(
