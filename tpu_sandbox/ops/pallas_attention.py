@@ -18,11 +18,14 @@ Shapes and grid:
   output + logsumexp are written on the last kv step.
 
 Backward is the standard flash backward recomputation — no O(S²) residual is
-saved, only (q, k, v, out, lse). It is expressed as a ``lax.scan`` over kv
-blocks in plain jnp (per SURVEY's "let XLA fuse" stance: the backward is
-bandwidth-bound elementwise+matmul chains XLA schedules well; the win of a
-hand kernel is in the forward's scratch-resident recurrence), so memory stays
-O(S · block_k) and the same code runs on CPU tests and TPU.
+saved, only (q, k, v, out, lse) — and runs as two Pallas kernels (VERDICT
+r01 weak #4: the first version scanned kv blocks in jnp, holding
+[S, block_k] score slabs): a dk/dv kernel with q blocks innermost and a dq
+kernel with kv blocks innermost, both accumulating in VMEM scratch with the
+[block_q, block_k] probability tile recomputed from the saved logsumexp.
+Peak memory is O(block² ) per core in both passes. The jnp scan version is
+kept as ``_blockwise_bwd`` — the reference implementation the kernels are
+tested against.
 
 Falls back to interpret mode off-TPU automatically, like ops.pallas_ce.
 """
@@ -42,6 +45,24 @@ from tpu_sandbox.ops.pallas_common import (
     default_interpret,
     round_up as _round_up,
 )
+
+
+def _to_bhsd(x, s_target: int, d_target: int):
+    """[B, S, H, D] -> [B, H, s_target, d_target]: the kernel layout
+    (heads to dim 1, sequence zero-padded to the block multiple, head dim
+    to the lane tile). Single home for the padding convention — forward,
+    lse-forward and backward all go through here."""
+    x = jnp.moveaxis(x, 2, 1)
+    return jnp.pad(
+        x,
+        ((0, 0), (0, 0), (0, s_target - x.shape[2]),
+         (0, d_target - x.shape[3])),
+    )
+
+
+def _from_bhsd(x, s: int, d: int):
+    """Inverse of _to_bhsd: slice off padding, heads back to dim 2."""
+    return jnp.moveaxis(x[:, :, :s, :d], 1, 2)
 
 
 def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -165,6 +186,196 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
     )(*offs, q, k, v)
 
 
+def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, causal: bool, block_q: int, block_k: int,
+                    kv_len: int):
+    """dk/dv: grid (B, H, kv blocks, q blocks), q innermost (accumulates).
+
+    Standard flash backward with saved lse: p = exp(s - lse);
+    dv += pᵀ·do; ds = p ⊙ (do·vᵀ - delta) · scale; dk += dsᵀ·q.
+    Peak memory is the [block_q, block_k] tile + two [block_k, d] scratch
+    accumulators — O(block), the VERDICT r01 weak #4 fix (the jnp scan
+    backward held [S, block_k] score slabs per step).
+    """
+    j, i = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    should_run = True
+    if causal:  # q block entirely before the kv block -> nothing flows
+        should_run = (
+            q_off_ref[0, 0] + (i + 1) * block_q - 1
+            >= kv_off_ref[0, 0] + j * block_k
+        )
+
+    @pl.when(should_run)
+    def _step():
+        f32 = jnp.float32
+        q = q_ref[0, 0].astype(f32)
+        k = k_ref[0, 0].astype(f32)
+        v = v_ref[0, 0].astype(f32)
+        do = do_ref[0, 0].astype(f32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        ) * scale                                     # [bq, bk]
+        q_pos = q_off_ref[0, 0] + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kv_off_ref[0, 0] + j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < kv_off_ref[0, 0] + kv_len
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=f32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=f32
+        )
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_scr,
+                   *, scale: float, causal: bool, block_q: int, block_k: int,
+                   kv_len: int):
+    """dq: grid (B, H, q blocks, kv blocks), kv innermost (accumulates).
+    dq += ds·k·scale with the same p/ds tiles as the dk/dv kernel."""
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    should_run = True
+    if causal:
+        should_run = (
+            kv_off_ref[0, 0] + j * block_k
+            <= q_off_ref[0, 0] + (i + 1) * block_q - 1
+        )
+
+    @pl.when(should_run)
+    def _step():
+        f32 = jnp.float32
+        q = q_ref[0, 0].astype(f32)
+        k = k_ref[0, 0].astype(f32)
+        v = v_ref[0, 0].astype(f32)
+        do = do_ref[0, 0].astype(f32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        ) * scale
+        q_pos = q_off_ref[0, 0] + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kv_off_ref[0, 0] + j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < kv_off_ref[0, 0] + kv_len
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=f32
+        )
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _emit():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, delta, lse, g, scale, causal, block_q, block_k,
+               interpret, kv_len, q_offset=0, kv_offset=0, out_dtype=None):
+    """Pallas backward: (dq, dk, dv), peak memory O(block) per core.
+
+    q,k,v,g [B,H,S,D] (block-padded, lane-aligned), lse [B,H,S] fp32,
+    delta = rowsum(g ⊙ out) [B,H,S] precomputed by the caller (once — ring
+    callers reuse it across hops). ``out_dtype`` overrides the gradient
+    dtype (ring callers pass fp32 so per-hop partials accumulate unrounded).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = default_interpret(interpret)
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    offs = [jnp.asarray(x, jnp.int32).reshape(1, 1)
+            for x in (q_offset, kv_offset)]
+    smem = functools.partial(pl.BlockSpec, (1, 1),
+                             lambda b, h, x, y: (0, 0),
+                             memory_space=pltpu.SMEM)
+
+    def spec(blk, pos):  # [*, *, blk, d] tensors indexed by grid dim `pos`
+        return pl.BlockSpec(
+            (1, 1, blk, d),
+            (lambda b, h, x, y: (b, h, x, 0)) if pos == 2
+            else (lambda b, h, x, y: (b, h, y, 0)),
+        )
+
+    qspec = functools.partial(spec, block_q)
+    kspec = functools.partial(spec, block_k)
+
+    def rowspec(pos):  # lse/delta [B, H, S] blocks
+        return pl.BlockSpec(
+            (1, 1, block_q),
+            (lambda b, h, x, y: (b, h, x)) if pos == 2
+            else (lambda b, h, x, y: (b, h, y)),
+        )
+
+    params = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=kv_len)
+    compiler = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+    )
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **params),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, out_dtype or k.dtype),
+            jax.ShapeDtypeStruct(v.shape, out_dtype or v.dtype),
+        ),
+        grid=(b, h, sk // block_k, s // block_q),
+        in_specs=[smem(), smem(), qspec(3), kspec(2), kspec(2), qspec(3),
+                  rowspec(3), rowspec(3)],
+        out_specs=(kspec(2), kspec(2)),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=compiler,
+        interpret=interpret,
+    )(*offs, q, k, v, g, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **params),
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
+        grid=(b, h, s // block_q, sk // block_k),
+        in_specs=[smem(), smem(), qspec(2), kspec(3), kspec(3), qspec(2),
+                  rowspec(2), rowspec(2)],
+        out_specs=qspec(2),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=compiler,
+        interpret=interpret,
+    )(*offs, q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 def _blockwise_bwd(q, k, v, out, lse, g, scale, causal, block_k, kv_len):
     """Flash backward: scan over kv blocks, O(S·block_k) live memory.
 
@@ -227,8 +438,9 @@ def _core_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
 
 def _core_bwd(scale, causal, block_q, block_k, interpret, kv_len, res, g):
     q, k, v, out, lse = res
-    return _blockwise_bwd(q, k, v, out, lse, g, scale, causal, block_k,
-                          kv_len)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return _flash_bwd(q, k, v, delta, lse, g, scale, causal, block_q, block_k,
+                      interpret, kv_len)
 
 
 _flash_core.defvjp(_core_fwd, _core_bwd)
@@ -257,14 +469,11 @@ def flash_attention(
     lcm = math.lcm(block_q, block_k)
     sp = _round_up(max(s, lcm), lcm)
     dp = _round_up(d, _LANE)
-
-    def prep(x):
-        x = jnp.moveaxis(x, 2, 1)  # [B, H, S, D]
-        return jnp.pad(x, ((0, 0), (0, 0), (0, sp - s), (0, dp - d)))
-
-    out = _flash_core(prep(q), prep(k), prep(v), scale, causal,
-                      block_q, block_k, interpret, s)
-    return jnp.moveaxis(out[:, :, :s, :d], 1, 2)
+    out = _flash_core(
+        _to_bhsd(q, sp, dp), _to_bhsd(k, sp, dp), _to_bhsd(v, sp, dp),
+        scale, causal, block_q, block_k, interpret, s,
+    )
+    return _from_bhsd(out, s, d)
 
 
 def flash_attention_lse(
@@ -293,24 +502,57 @@ def flash_attention_lse(
     sp = _round_up(max(s, block_q), block_q)
     skp = _round_up(max(sk, block_k), block_k)
     dp = _round_up(d, _LANE)
-
-    def prep(x, target):
-        x = jnp.moveaxis(x, 2, 1)  # [B, H, S, D]
-        return jnp.pad(
-            x, ((0, 0), (0, 0), (0, target - x.shape[2]), (0, dp - d))
-        )
-
-    qp, kp, vp = prep(q, sp), prep(k, skp), prep(v, skp)
     # padded q rows also run; their garbage rows are sliced off below, and
     # the grid only needs square-compatible blocks, not equal q/kv lengths.
     # fp32 partials: the caller's logsumexp merge must not see bf16 rounding
-    out, lse = _flash_fwd(qp, kp, vp, scale, causal, block_q, block_k,
-                          interpret, sk, q_offset=q_offset,
-                          kv_offset=kv_offset, out_dtype=jnp.float32)
+    out, lse = _flash_fwd(
+        _to_bhsd(q, sp, dp), _to_bhsd(k, skp, dp), _to_bhsd(v, skp, dp),
+        scale, causal, block_q, block_k, interpret, sk, q_offset=q_offset,
+        kv_offset=kv_offset, out_dtype=jnp.float32,
+    )
     return (
-        jnp.moveaxis(out[:, :, :s, :d], 1, 2),
+        _from_bhsd(out, s, d),
         jnp.moveaxis(lse[:, :, :s], 1, 2),  # [B, S, H]
     )
+
+
+def make_flash_bwd_lse(
+    q, out, g, lse, *,
+    causal: bool = True,
+    q_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Partial-attention backward factory, [B, S, H, D] layout: pads the
+    loop-invariant q-side tensors and computes delta = rowsum(g ⊙ out)
+    ONCE, returning ``fn(k_blk, v_blk, kv_offset) -> (dq, dk, dv)`` for the
+    per-hop calls of flash-ring's backward (parallel/flash_ring.py) — only
+    the rotating K/V blocks are padded per hop. Gradients come back fp32 so
+    ring callers can accumulate hops unrounded. ``lse`` [B, S, H] is the
+    FINAL (merged) logsumexp.
+    """
+    b, s, hh, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    sp = _round_up(max(s, block_q), block_q)
+    dp = _round_up(d, _LANE)
+    qp, outp, gp = (_to_bhsd(x, sp, dp) for x in (q, out, g))
+    # padded q rows: zero q/g rows give p = exp(0 - 0) = 1 but ds = dv = 0
+    # through the zero cotangent, so padding lse with 0 is safe
+    lse_p = jnp.pad(jnp.moveaxis(lse, 2, 1), ((0, 0), (0, 0), (0, sp - s)))
+    delta = jnp.sum(gp.astype(jnp.float32) * outp.astype(jnp.float32), -1)
+
+    def partial_bwd(k_blk, v_blk, kv_offset):
+        sk = k_blk.shape[1]
+        skp = _round_up(max(sk, block_k), block_k)
+        dq, dk, dv = _flash_bwd(
+            qp, _to_bhsd(k_blk, skp, dp), _to_bhsd(v_blk, skp, dp), delta,
+            lse_p, gp, scale, causal, block_q, block_k, interpret, sk,
+            q_offset=q_offset, kv_offset=kv_offset, out_dtype=jnp.float32,
+        )
+        return _from_bhsd(dq, s, d), _from_bhsd(dk, sk, d), _from_bhsd(dv, sk, d)
+
+    return partial_bwd
 
 
 def flash_attention_fn(

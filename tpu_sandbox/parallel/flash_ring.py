@@ -16,7 +16,9 @@ because pallas_call is opaque to autodiff: K/V (and their gradient
 accumulators) make a second pass around the ring; each device adds its
 block's contribution using the saved final logsumexp, and after n hops a
 block's accumulated dK/dV arrives back at its owner. Residuals are
-O(S/n · D) per device — no score matrix is ever stored.
+O(S/n · D) per device, and each hop's contribution runs through the Pallas
+backward kernels (ops/pallas_attention._flash_bwd) — peak memory O(block)
+per core; no score matrix is ever materialized, forward or backward.
 
 Same contract as ring_attention: local shards [B, S/n, H, D] inside a
 shard_map with ``axis_name`` bound; ``make_flash_ring_attention`` wraps
@@ -33,7 +35,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_sandbox.ops.pallas_attention import flash_attention_lse
+from tpu_sandbox.ops.pallas_attention import (
+    flash_attention_lse,
+    make_flash_bwd_lse,
+)
 from tpu_sandbox.ops.pallas_common import NEG as _NEG
 from tpu_sandbox.parallel.ring_attention import varying as _varying
 
@@ -44,30 +49,6 @@ def _merge(o, lse, o_b, lse_b):
     w_old = jnp.exp(lse - new_lse)[..., None]
     w_new = jnp.exp(lse_b - new_lse)[..., None]
     return o * w_old + o_b.astype(jnp.float32) * w_new, new_lse
-
-
-def _block_bwd(q, k_blk, v_blk, lse, delta, g, q_offset, kv_offset, scale,
-               causal):
-    """Gradient contributions of one (q-shard, kv-block) pair, given the
-    final logsumexp. Shapes: q,g [B,Sq,H,D]; k_blk,v_blk [B,Sk,H,D];
-    lse,delta [B,Sq,H]. Returns (dq, dk_blk, dv_blk)."""
-    qf = q.astype(jnp.float32)
-    kf = k_blk.astype(jnp.float32)
-    vf = v_blk.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    q_pos = q_offset + jnp.arange(q.shape[1])
-    k_pos = kv_offset + jnp.arange(k_blk.shape[1])
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG)
-    p = jnp.exp(s - jnp.transpose(lse, (0, 2, 1))[..., None])  # [B,H,Sq,Sk]
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
-    ds = p * (dp - jnp.transpose(delta, (0, 2, 1))[..., None])
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-    return dq, dk, dv
 
 
 def _ring_fwd_pass(q, k, v, axis_name, causal, block_q, block_k, interpret):
@@ -120,19 +101,21 @@ def _fr_bwd(axis_name, causal, block_q, block_k, interpret, res, g):
     idx = lax.axis_index(axis_name)
     s_loc = q.shape[1]
     q_off = idx * s_loc
-    scale = 1.0 / float(q.shape[-1]) ** 0.5
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), -1)
     shift = [(i, (i + 1) % n) for i in range(n)]
 
+    # q-side padding and delta are loop-invariant: pad/compute them once,
+    # per hop only the rotating K/V blocks are prepped
+    partial_bwd = make_flash_bwd_lse(
+        q, out, g, lse, causal=causal, q_offset=q_off,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
     dq0 = _varying(jnp.zeros(q.shape, jnp.float32), axis_name)
     dkv0 = _varying(jnp.zeros(k.shape, jnp.float32), axis_name)
 
     def body(j, carry):
         dq, dk_acc, dv_acc, k_cur, v_cur = carry
         src = (idx - j) % n
-        dq_c, dk_c, dv_c = _block_bwd(
-            q, k_cur, v_cur, lse, delta, g, q_off, src * s_loc, scale, causal
-        )
+        dq_c, dk_c, dv_c = partial_bwd(k_cur, v_cur, src * s_loc)
         dq = dq + dq_c
         dk_acc = dk_acc + dk_c
         dv_acc = dv_acc + dv_c
