@@ -1,0 +1,155 @@
+"""Recorded loss-curve parity experiment: this framework vs a torch replica.
+
+VERDICT r01 "what's missing" #1: step-level unit parity existed, but no
+END-TO-END loss curve of the reference experiment was ever recorded. This
+script is that record. It trains the reference schedule (ConvNet, CE,
+plain SGD — mnist_onegpu.py:34-84) twice from bit-identical init on
+bit-identical batches:
+
+  - the tpu_sandbox trainer (flax/optax, the framework under test), and
+  - a torch replica with the weights copied over
+    (tpu_sandbox/utils/parity.py),
+
+and writes both loss curves to a JSONL file plus a summary line with the
+maximum absolute and relative per-step deviation.
+
+Data: the environment has zero network egress, so torchvision's MNIST
+download (reference mnist_onegpu.py:92-95) cannot run; the deterministic
+synthetic MNIST (tpu_sandbox/data/mnist.py::synthetic_mnist) stands in, and
+``--data-dir`` accepts real IDX files wherever they can be staged. The
+28x28 -> NxN resize is applied ONCE on the host with jax.image.resize and
+the SAME resized arrays feed both frameworks: resize-kernel differences
+between torchvision PIL and XLA are an input-pipeline property, not a
+training-dynamics property, and this experiment isolates the latter.
+
+Default config scales the reference experiment to CPU-feasible size
+(128x128, bs=5, 400 steps); on a TPU with time to spare, pass
+--image-size 3000 --steps 12000 for the full reference shape (the torch
+side will be slow: it is the control, not the subject).
+
+Usage::
+
+    python parity_run.py --out parity_curves.jsonl
+"""
+
+import argparse
+import json
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--image-size", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=5)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--n-data", type=int, default=2000)
+    p.add_argument("--data-dir", type=str, default=None,
+                   help="real MNIST IDX dir (falls back to synthetic)")
+    p.add_argument("--out", type=str, default="parity_curves.jsonl")
+    p.add_argument("--force-cpu", action="store_true")
+    args = p.parse_args()
+
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    if args.force_cpu:
+        ensure_devices(1, force_cpu=True)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import torch
+
+    from tpu_sandbox.data.mnist import load_mnist, normalize, synthetic_mnist
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.train import TrainState, make_train_step
+    from tpu_sandbox.utils.parity import torch_twin
+
+    try:
+        images, labels = load_mnist("train", args.data_dir)
+        source = "mnist-idx"
+    except FileNotFoundError:
+        images, labels = synthetic_mnist(n=args.n_data, seed=0)
+        source = "synthetic"
+    images = normalize(images[: args.n_data])
+    labels = labels[: args.n_data].astype(np.int64)
+
+    # one host-side resize feeds BOTH frameworks identical pixels
+    n = args.image_size
+    resized = np.asarray(
+        jax.image.resize(
+            jnp.asarray(images), (len(images), n, n, 1), method="bilinear"
+        )
+    )
+
+    rng = np.random.default_rng(0)
+    order = [rng.permutation(len(resized))[: args.batch_size]
+             for _ in range(args.steps)]
+
+    model = ConvNet()
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, n, n, 1)), train=False
+    )
+    tm = torch_twin(torch, variables["params"], hw=n // 4)
+
+    # --- framework under test -------------------------------------------
+    tx = optax.sgd(args.lr)
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, n, n, 1)), tx
+    )
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    step = make_train_step(model, tx, donate=False)
+    jax_losses = []
+    for i, sel in enumerate(order):
+        state, loss = step(
+            state, jnp.asarray(resized[sel]),
+            jnp.asarray(labels[sel].astype(np.int32)),
+        )
+        jax_losses.append(float(loss))
+        if (i + 1) % args.log_every == 0:
+            print(f"[tpu_sandbox] Step [{i + 1}/{args.steps}], "
+                  f"Loss: {jax_losses[-1]:.4f}", flush=True)
+
+    # --- torch control ---------------------------------------------------
+    tm.train()
+    opt = torch.optim.SGD(tm.parameters(), lr=args.lr)
+    crit = torch.nn.CrossEntropyLoss()
+    torch_losses = []
+    for i, sel in enumerate(order):
+        opt.zero_grad()
+        out = tm(torch.from_numpy(resized[sel].transpose(0, 3, 1, 2).copy()))
+        loss = crit(out, torch.from_numpy(labels[sel]))
+        loss.backward()
+        opt.step()
+        torch_losses.append(float(loss))
+        if (i + 1) % args.log_every == 0:
+            print(f"[torch-ref]   Step [{i + 1}/{args.steps}], "
+                  f"Loss: {torch_losses[-1]:.4f}", flush=True)
+
+    ja, ta = np.asarray(jax_losses), np.asarray(torch_losses)
+    abs_dev = np.abs(ja - ta)
+    rel_dev = abs_dev / np.maximum(np.abs(ta), 1e-8)
+    summary = {
+        "source": source,
+        "image_size": n,
+        "batch_size": args.batch_size,
+        "steps": args.steps,
+        "lr": args.lr,
+        "final_loss_tpu_sandbox": round(float(ja[-1]), 6),
+        "final_loss_torch": round(float(ta[-1]), 6),
+        "max_abs_dev": round(float(abs_dev.max()), 6),
+        "max_rel_dev": round(float(rel_dev.max()), 6),
+        "mean_abs_dev": round(float(abs_dev.mean()), 6),
+    }
+    with open(args.out, "w") as f:
+        for i, (jl, tl) in enumerate(zip(jax_losses, torch_losses)):
+            f.write(json.dumps({"step": i + 1, "tpu_sandbox": round(jl, 6),
+                                "torch": round(tl, 6)}) + "\n")
+        f.write(json.dumps({"summary": summary}) + "\n")
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

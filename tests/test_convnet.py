@@ -63,88 +63,16 @@ def test_numerical_parity_with_torch_reference():
     eval-mode forward outputs — verifies conv padding, BN eps, pool, and
     flatten-order semantics match the architecture the reference trains."""
     torch = pytest.importorskip("torch")
-    tnn = torch.nn
+    from tpu_sandbox.utils.parity import torch_twin
 
     model, variables = init_model(16, 16)
-    params = variables["params"]
-
-    class TorchNet(tnn.Module):
-        def __init__(self):
-            super().__init__()
-            self.layer1 = tnn.Sequential(
-                tnn.Conv2d(1, 16, 5, stride=1, padding=2),
-                tnn.BatchNorm2d(16), tnn.ReLU(), tnn.MaxPool2d(2, 2))
-            self.layer2 = tnn.Sequential(
-                tnn.Conv2d(16, 32, 5, stride=1, padding=2),
-                tnn.BatchNorm2d(32), tnn.ReLU(), tnn.MaxPool2d(2, 2))
-            self.fc = tnn.Linear(32 * 4 * 4, 10)
-
-        def forward(self, x):
-            x = self.layer2(self.layer1(x))
-            return self.fc(x.reshape(x.shape[0], -1))
-
-    tm = TorchNet().eval()
-    with torch.no_grad():
-        for i, layer in enumerate([tm.layer1, tm.layer2], start=1):
-            # flax conv kernel HWIO -> torch OIHW
-            k = np.asarray(params[f"conv{i}"]["kernel"]).transpose(3, 2, 0, 1).copy()
-            layer[0].weight.copy_(torch.from_numpy(k))
-            layer[0].bias.copy_(torch.from_numpy(np.asarray(params[f"conv{i}"]["bias"])))
-            layer[1].weight.copy_(torch.from_numpy(np.asarray(params[f"bn{i}"]["scale"])))
-            layer[1].bias.copy_(torch.from_numpy(np.asarray(params[f"bn{i}"]["bias"])))
-
-        # flax flatten order is NHWC; permute torch's NCHW activations to
-        # match by building the fc weight accordingly: torch flatten of
-        # [N,C,H,W] vs flax flatten of [N,H,W,C]
-        fck = np.asarray(params["fc"]["kernel"])  # [H*W*C, 10] in HWC order
-        fck_hwc = fck.reshape(4, 4, 32, 10).transpose(2, 0, 1, 3).reshape(512, 10)
-        tm.fc.weight.copy_(torch.from_numpy(fck_hwc.T))
-        tm.fc.bias.copy_(torch.from_numpy(np.asarray(params["fc"]["bias"])))
+    tm = torch_twin(torch, variables["params"], hw=4).eval()
 
     x = np.random.default_rng(0).normal(size=(2, 16, 16, 1)).astype(np.float32)
     jax_out = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
     with torch.no_grad():
         torch_out = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
     np.testing.assert_allclose(jax_out, torch_out, atol=1e-4)
-
-
-def _torch_twin(torch, params, hw=4):
-    """Torch replica of the reference stack with weights copied from flax
-    params (shared by the forward-parity and loss-curve-parity tests)."""
-    tnn = torch.nn
-
-    class TorchNet(tnn.Module):
-        def __init__(self):
-            super().__init__()
-            self.layer1 = tnn.Sequential(
-                tnn.Conv2d(1, 16, 5, stride=1, padding=2),
-                tnn.BatchNorm2d(16), tnn.ReLU(), tnn.MaxPool2d(2, 2))
-            self.layer2 = tnn.Sequential(
-                tnn.Conv2d(16, 32, 5, stride=1, padding=2),
-                tnn.BatchNorm2d(32), tnn.ReLU(), tnn.MaxPool2d(2, 2))
-            self.fc = tnn.Linear(32 * hw * hw, 10)
-
-        def forward(self, x):
-            x = self.layer2(self.layer1(x))
-            return self.fc(x.reshape(x.shape[0], -1))
-
-    tm = TorchNet()
-    with torch.no_grad():
-        for i, layer in enumerate([tm.layer1, tm.layer2], start=1):
-            k = np.asarray(params[f"conv{i}"]["kernel"]).transpose(3, 2, 0, 1).copy()
-            layer[0].weight.copy_(torch.from_numpy(k))
-            layer[0].bias.copy_(torch.from_numpy(
-                np.asarray(params[f"conv{i}"]["bias"]).copy()))
-            layer[1].weight.copy_(torch.from_numpy(
-                np.asarray(params[f"bn{i}"]["scale"]).copy()))
-            layer[1].bias.copy_(torch.from_numpy(
-                np.asarray(params[f"bn{i}"]["bias"]).copy()))
-        fck = np.asarray(params["fc"]["kernel"])
-        fck_hwc = (fck.reshape(hw, hw, 32, 10)
-                   .transpose(2, 0, 1, 3).reshape(32 * hw * hw, 10))
-        tm.fc.weight.copy_(torch.from_numpy(fck_hwc.T.copy()))
-        tm.fc.bias.copy_(torch.from_numpy(np.asarray(params["fc"]["bias"]).copy()))
-    return tm
 
 
 def test_training_loss_curve_parity_with_torch():
@@ -156,10 +84,11 @@ def test_training_loss_curve_parity_with_torch():
     import optax
 
     from tpu_sandbox.train import TrainState, make_train_step
+    from tpu_sandbox.utils.parity import torch_twin
 
     lr, steps, bs = 0.05, 8, 8
     model, variables = init_model(16, 16)
-    tm = _torch_twin(torch, variables["params"], hw=4)
+    tm = torch_twin(torch, variables["params"], hw=4)
 
     rng = np.random.default_rng(42)
     batches = [

@@ -1,0 +1,59 @@
+"""Torch-replica twin of the reference ConvNet, for parity experiments.
+
+The reference architecture is torch (mnist_onegpu.py:11-31); this framework
+re-implements it in flax (models/convnet.py). To demonstrate end-to-end
+loss-curve parity — not just per-op equality — this module builds the torch
+model with weights COPIED from the flax params, so both frameworks start
+from bit-identical init and can be trained on identical batches
+(parity_run.py at the repo root records the experiment; tests/test_convnet.py
+asserts it at short horizon).
+
+Layout conversions: flax conv kernels are HWIO -> torch OIHW; the flax
+flatten is NHWC-ordered while torch flattens NCHW, so the fc weight is
+re-blocked accordingly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def torch_twin(torch, params, hw: int):
+    """Torch replica of the reference stack (conv 1->16 k5 p2, BN, ReLU,
+    pool /2; conv 16->32; fc -> 10) with weights copied from flax
+    ``params``. ``hw`` = spatial size after the two pools (H/4 for square
+    inputs)."""
+    tnn = torch.nn
+
+    class TorchNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layer1 = tnn.Sequential(
+                tnn.Conv2d(1, 16, 5, stride=1, padding=2),
+                tnn.BatchNorm2d(16), tnn.ReLU(), tnn.MaxPool2d(2, 2))
+            self.layer2 = tnn.Sequential(
+                tnn.Conv2d(16, 32, 5, stride=1, padding=2),
+                tnn.BatchNorm2d(32), tnn.ReLU(), tnn.MaxPool2d(2, 2))
+            self.fc = tnn.Linear(32 * hw * hw, 10)
+
+        def forward(self, x):
+            x = self.layer2(self.layer1(x))
+            return self.fc(x.reshape(x.shape[0], -1))
+
+    tm = TorchNet()
+    with torch.no_grad():
+        for i, layer in enumerate([tm.layer1, tm.layer2], start=1):
+            k = np.asarray(params[f"conv{i}"]["kernel"]).transpose(3, 2, 0, 1).copy()
+            layer[0].weight.copy_(torch.from_numpy(k))
+            layer[0].bias.copy_(torch.from_numpy(
+                np.asarray(params[f"conv{i}"]["bias"]).copy()))
+            layer[1].weight.copy_(torch.from_numpy(
+                np.asarray(params[f"bn{i}"]["scale"]).copy()))
+            layer[1].bias.copy_(torch.from_numpy(
+                np.asarray(params[f"bn{i}"]["bias"]).copy()))
+        fck = np.asarray(params["fc"]["kernel"])
+        fck_hwc = (fck.reshape(hw, hw, 32, 10)
+                   .transpose(2, 0, 1, 3).reshape(32 * hw * hw, 10))
+        tm.fc.weight.copy_(torch.from_numpy(fck_hwc.T.copy()))
+        tm.fc.bias.copy_(torch.from_numpy(np.asarray(params["fc"]["bias"]).copy()))
+    return tm
