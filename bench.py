@@ -215,9 +215,185 @@ def bench_allreduce_bw(force_cpu: bool) -> dict:
         "devices": jax.device_count(),
         "device_kind": str(jax.devices()[0].device_kind),
     }
-    if jax.device_count() == 1:
+    if "degraded" in r:  # e.g. non-positive differential after retry
+        result["degraded"] = r["degraded"]
+    elif jax.device_count() == 1:
         # busbw = algbw * 2*(n-1)/n is identically 0 at n=1; say why
         result["degraded"] = "single device; no interconnect to measure"
+    return result
+
+
+def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
+                   max_batch: int = 512) -> dict:
+    """The reference's published experiment, measured: max batch at
+    image_size² on ONE device (reference README.md:9-15 — bs=10 OOMs a
+    24 GB A5000, bs=5 runs; DDP trains effective 10). Doubling probe then
+    binary search, each trial in a fresh jit with its own allocation;
+    allocator failures (RESOURCE_EXHAUSTED / XlaRuntimeError OOM) are the
+    signal, not an error. Each working batch runs ONE fetch-synced train
+    step so the number means 'trains', not 'allocates'."""
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    if force_cpu:
+        ensure_devices(1, force_cpu=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpu_sandbox.data import synthetic_mnist
+    from tpu_sandbox.data.mnist import normalize
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.train import TrainState, make_train_step
+    from tpu_sandbox.utils.profiling import host_sync
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    model = ConvNet(dtype=dtype)
+    tx = optax.sgd(1e-4)
+    images, labels = synthetic_mnist(n=max_batch, seed=0)
+    images, labels = normalize(images), labels.astype("int32")
+
+    def trial(bs: int) -> bool:
+        try:
+            state = TrainState.create(
+                model, jax.random.key(0),
+                jnp.zeros((1, image_size, image_size, 1), dtype), tx,
+            )
+            step = make_train_step(
+                model, tx, image_size=(image_size, image_size), donate=True
+            )
+            state, loss = step(state, jnp.asarray(images[:bs]),
+                               jnp.asarray(labels[:bs]))
+            ok = bool(np.isfinite(host_sync(loss)))
+            del state
+            return ok
+        except Exception as e:  # allocator failure IS the measurement
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" in msg or "OOM" in msg.upper() or (
+                "out of memory" in msg.lower()
+            ):
+                return False
+            raise
+
+    lo, hi = 0, None
+    bs = 1
+    while bs <= max_batch:
+        if trial(bs):
+            lo = bs
+            bs *= 2
+        else:
+            hi = bs
+            break
+    if hi is None:
+        hi = max_batch + 1  # never failed up to the cap
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if trial(mid):
+            lo = mid
+        else:
+            hi = mid
+
+    dev = jax.devices()[0]
+    result = {
+        "metric": "max_train_batch_one_device",
+        "value": lo,
+        "unit": f"images @ {image_size}x{image_size} {dtype_name}",
+        "vs_baseline": round(lo / 5.0, 2),  # reference: bs=5 fits, 10 OOMs
+        "baseline_kind": "reference A5000 24GB: bs=5 runs, bs=10 OOMs "
+                         "(README.md:9-15)",
+        "first_oom_batch": hi if hi <= max_batch else None,
+        "probe_cap": max_batch,
+        "device_kind": str(dev.device_kind),
+    }
+    if dev.platform == "cpu":
+        result["degraded"] = ("CPU host memory, not accelerator HBM — "
+                              "capacity number not comparable")
+    return result
+
+
+def bench_seq_scaling(force_cpu: bool, seq_lens=None, devices_wanted: int = 4,
+                      quick: bool = False) -> dict:
+    """Sequence-parallel attention scaling: ring (jnp) vs flash-ring
+    (Pallas) vs Ulysses (all-to-all + flash) forward+backward step time at
+    growing S on a 1-axis 'sp' mesh — VERDICT r01 item 5's seq-len table.
+    On one real chip the mesh folds to 1 device (collectives are identity;
+    still measures the kernels); on CPU it runs the full ring semantics on
+    virtual devices."""
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    if force_cpu:
+        ensure_devices(devices_wanted, force_cpu=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_sandbox.parallel import (
+        make_flash_ring_attention,
+        make_ring_attention,
+        make_ulysses_attention,
+    )
+    from tpu_sandbox.runtime.mesh import make_mesh
+    from tpu_sandbox.utils.profiling import measure_per_step
+
+    n_dev = jax.device_count()
+    mesh = make_mesh({"sp": n_dev})
+    b, h, d = (1, 4, 64) if quick else (1, 8, 128)
+    if seq_lens is None:
+        seq_lens = [256, 512] if quick else [4096, 8192, 16384, 32768]
+
+    makers = {
+        "ring": lambda: make_ring_attention(mesh, "sp"),
+        "flash_ring": lambda: make_flash_ring_attention(mesh, "sp"),
+        "ulysses": lambda: make_ulysses_attention(mesh, "sp"),
+    }
+    rng = np.random.default_rng(0)
+    rows = []
+    for s in seq_lens:
+        row = {"seq_len": s}
+        q, k, v = (jnp.asarray(
+            rng.standard_normal((b, s, h, d)), jnp.bfloat16) for _ in range(3))
+        for name, make in makers.items():
+            try:
+                attn = make()
+                fwdbwd = jax.jit(jax.grad(
+                    lambda q: jnp.sum(attn(q, k, v).astype(jnp.float32))
+                ))
+
+                def run(steps):
+                    x = q
+                    for _ in range(steps):
+                        x = fwdbwd(x).astype(jnp.bfloat16)
+                    return x
+
+                t = measure_per_step(run, 2)
+                # noise-negative differentials are not published (see
+                # BASELINE.md "the r01 anomaly"); record why instead
+                if t["sec_per_step"] > 0:
+                    row[name + "_sec"] = t["sec_per_step"]
+                else:
+                    row[name + "_sec"] = None
+                    row[name + "_error"] = (
+                        f"non-positive differential {t['sec_per_step']:.3e}s"
+                    )
+            except Exception as e:
+                row[name + "_sec"] = None
+                row[name + "_error"] = f"{type(e).__name__}: {e}"[:200]
+        rows.append(row)
+
+    base = rows[-1].get("ring_sec")
+    best = rows[-1].get("flash_ring_sec")
+    result = {
+        "metric": "sp_attention_seq_scaling",
+        "value": round(base / best, 3) if base and best else 0.0,
+        "unit": f"ring/flash_ring speedup @ S={rows[-1]['seq_len']} (fwd+bwd)",
+        "vs_baseline": 0.0,
+        "devices": n_dev,
+        "device_kind": str(jax.devices()[0].device_kind),
+        "shape": {"batch": b, "heads": h, "head_dim": d},
+        "rows": rows,
+    }
+    if not (base and best):
+        result["degraded"] = "headline pair unmeasured (see rows *_error)"
     return result
 
 
@@ -310,7 +486,8 @@ def _chain_attn(fa, q, k, v, n):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
-                   choices=["images_per_sec", "allreduce_bw", "pallas"],
+                   choices=["images_per_sec", "allreduce_bw", "pallas",
+                            "capacity", "seq_scaling"],
                    default="images_per_sec",
                    help="which benchmark to run (driver default: images/sec)")
     p.add_argument("--image-size", type=int, default=3000)
@@ -327,14 +504,32 @@ def main():
                    help="seconds to wait for the accelerator before falling "
                         "back to a small CPU run (0 = skip probe)")
     args = p.parse_args()
-    if args.metric in ("allreduce_bw", "pallas"):
+    if args.metric != "images_per_sec":
         # probe-timeout 0 means "trust the environment" (same semantics as
         # the images/sec path), not "force CPU"
         usable = not args.probe_timeout or accelerator_usable(args.probe_timeout)
-        fn = bench_allreduce_bw if args.metric == "allreduce_bw" else bench_pallas
-        result = fn(force_cpu=not usable)
+        if args.metric == "allreduce_bw":
+            result = bench_allreduce_bw(force_cpu=not usable)
+        elif args.metric == "pallas":
+            result = bench_pallas(force_cpu=not usable)
+        elif args.metric == "capacity":
+            result = bench_capacity(
+                args.image_size if not (args.quick or not usable) else 256,
+                args.dtype, force_cpu=not usable,
+                max_batch=8 if (args.quick or not usable) else 512,
+            )
+        else:
+            result = bench_seq_scaling(
+                force_cpu=not usable, quick=args.quick or not usable
+            )
         if not usable:
-            result["degraded"] = "accelerator unavailable; CPU fallback"
+            # append, never overwrite: a benchmark's own degraded reason
+            # (e.g. capacity's host-memory caveat) is the actionable one
+            fallback = "accelerator unavailable; CPU fallback"
+            result["degraded"] = (
+                f"{result['degraded']}; {fallback}"
+                if "degraded" in result else fallback
+            )
         print(json.dumps(result))
         return
     if args.quick:

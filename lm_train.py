@@ -121,7 +121,8 @@ def train(args):
         if cfg.n_layers % n:
             raise SystemExit(f"pp needs n_layers divisible by {n} devices")
         mesh = make_mesh({"data": 1, "pipe": n}, devices=devices)
-        eng = PipelineParallel(cfg, tx, mesh, microbatches=args.microbatches)
+        eng = PipelineParallel(cfg, tx, mesh, microbatches=args.microbatches,
+                               circular_chunks=args.circular_chunks)
         state = eng.init_state(rng, sample)
     elif p == "3d":
         # data x model x pipe: DP batch sharding, Megatron TP inside each
@@ -136,7 +137,8 @@ def train(args):
             )
         mesh = make_mesh(shape, devices=devices)
         eng = PipelineParallel(
-            cfg, tx, mesh, microbatches=args.microbatches, model_axis="model"
+            cfg, tx, mesh, microbatches=args.microbatches,
+            model_axis="model", circular_chunks=args.circular_chunks,
         )
         state = eng.init_state(rng, sample)
     elif p == "ep":
@@ -188,6 +190,9 @@ def main():
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--microbatches", type=int, default=2,
                         help="pp only: GPipe microbatches per step")
+    parser.add_argument("--circular-chunks", type=int, default=1,
+                        help="pp/3d: layer chunks per stage (v>1 = circular "
+                             "schedule, bubble ~v x smaller)")
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="fp32")
     parser.add_argument("--attn", choices=["ring", "ulysses", "flash_ring"],

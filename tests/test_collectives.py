@@ -97,8 +97,11 @@ def test_put_validates_leading_dim(group):
 
 
 def test_bandwidth_bench_runs(group):
-    r = group.allreduce_bandwidth(nbytes=1 << 12, iters=2)
-    assert r["busbw_GBps"] > 0 and r["bytes"] == (1 << 12)
+    r = group.allreduce_bandwidth(nbytes=1 << 16, iters=8)
+    assert r["bytes"] == (1 << 16)
+    # noise can zero the differential on a loaded CPU host; a published
+    # number must be positive, a degraded line must say why
+    assert r["busbw_GBps"] > 0 or "degraded" in r
 
 
 def test_all_to_all_transpose(mesh8):

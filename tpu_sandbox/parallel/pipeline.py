@@ -10,10 +10,32 @@ next stage with ``lax.ppermute`` over neighbor ICI links:
   leading [n_layers] dim, reshaped to [n_stages, layers_per_stage, ...] and
   sharded on 'pipe' — each device materializes only its own stage's layers
   (the model-memory win pipeline parallelism exists for);
-- a batch is split into M microbatches; the tick loop is a ``lax.scan``
-  over M + S - 1 ticks with the classic (S-1)/(M+S-1) bubble, and the
-  whole pipeline is one differentiable compiled program — backward runs
-  the reverse pipeline automatically.
+- a batch is split into M microbatches; the tick loop is a ``lax.scan``,
+  and the whole pipeline is one differentiable compiled program — backward
+  runs the reverse pipeline automatically.
+
+Schedules — GPipe and circular (interleaved) are ONE implementation,
+parameterized by ``circular_chunks`` (v):
+
+- v=1 is GPipe: each device holds n_layers/S consecutive blocks; M + S - 1
+  ticks, bubble (S-1)/(M+S-1).
+- v>1 is the circular schedule (Megatron's interleaved stages, praxis's
+  circular pipeline): each device holds v NON-consecutive layer chunks
+  (global layer order = chunk-major round-robin: chunk c of device i holds
+  layers [c·S·L + i·L .. +L)), and a microbatch rings around the devices v
+  times. Unit u = t - idx at tick t decodes to (chunk c, microbatch m);
+  the ring automatically delivers chunk c+1 of a microbatch to device 0
+  exactly when its schedule slot arrives. M·v + S - 1 ticks for M·v units
+  of work per device: the bubble shrinks to (S-1)/(M·v + S - 1) — ~v×
+  smaller at equal M. Cost: v× as many (smaller) ppermute hops; needs
+  M % S == 0.
+
+  Bubble fraction at S=4 stages (``bubble_fraction()``):
+
+      M      4      8      16
+      v=1  0.429  0.273  0.158
+      v=2  0.273  0.158  0.086
+      v=4  0.158  0.086  0.045
 
 Work is gated to the stage that owns it (VERDICT r01 weak #3 fixed — the
 first version embedded/headed the full batch on EVERY stage and carried a
@@ -163,6 +185,7 @@ class PipelineParallel:
         data_axis: str = "data",
         pipe_axis: str = "pipe",
         model_axis: str | None = None,
+        circular_chunks: int = 1,
         remat: bool = True,
         donate: bool = True,
     ):
@@ -178,9 +201,18 @@ class PipelineParallel:
         self.model_axis = model_axis
         self.remat = remat
         self.n_stages = mesh.shape[pipe_axis]
-        if config.n_layers % self.n_stages:
+        self.circular_chunks = v = circular_chunks
+        if v < 1:
+            raise ValueError(f"circular_chunks must be >= 1, got {v}")
+        if config.n_layers % (self.n_stages * v):
             raise ValueError(
-                f"{config.n_layers} layers not divisible by {self.n_stages} stages"
+                f"{config.n_layers} layers not divisible into "
+                f"{self.n_stages} stages x {v} chunks"
+            )
+        if v > 1 and microbatches % self.n_stages:
+            raise ValueError(
+                f"the circular schedule needs microbatches ({microbatches}) "
+                f"divisible by n_stages ({self.n_stages})"
             )
         if model_axis:
             m = mesh.shape[model_axis]
@@ -194,37 +226,49 @@ class PipelineParallel:
         self.model = TransformerLM(config)  # init / parity twin
         self._build(donate)
 
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the pipeline schedule:
+        (S-1) / (M·v + S - 1)."""
+        ticks = self.microbatches * self.circular_chunks + self.n_stages - 1
+        return (self.n_stages - 1) / ticks
+
     # -- state --------------------------------------------------------------
 
     def init_state(self, rng, sample_tokens) -> TrainState:
         state = TrainState.create(self.model, rng, sample_tokens, self.tx)
         pre, stacked, post = split_transformer_params(state.params, self.n_stages)
-        lps = self.config.n_layers // self.n_stages
+        v, n = self.circular_chunks, self.n_stages
+        lps = self.config.n_layers // (n * v)
+        # global layer order is chunk-major round-robin ([v, n, lps]);
+        # swap to [n, v, lps] so the sharded 'pipe' dim leads
         stacked = jax.tree.map(
-            lambda x: x.reshape(self.n_stages, lps, *x.shape[1:]), stacked
+            lambda x: x.reshape(v, n, lps, *x.shape[1:]).swapaxes(0, 1),
+            stacked,
         )
         params = {"pre": pre, "stages": stacked, "post": post}
         return state.replace(params=params, opt_state=self.tx.init(params))
 
     def _stage_leaf_spec(self, path: str, ndim: int) -> P:
         """'pipe' on the stacked leading dim; with tensor-parallel stages,
-        'model' on the Megatron dim of each kernel/bias (after the two
-        leading [stage, layer] dims)."""
+        'model' on the Megatron dim of each kernel/bias. Kernel dims are
+        indexed from the END — the leading [stage, chunk, layer] stack is
+        layout-dependent (chunk dim only exists conceptually; the leaves
+        are [S, v, L, ...])."""
         spec = [self.pipe_axis] + [None] * (ndim - 1)
         m = self.model_axis
         if m:
             if "qkv/kernel" in path:
-                spec[4] = m  # [S, L, d_model, 3, H, hd] -> heads
+                spec[ndim - 2] = m  # [..., d_model, 3, H, hd] -> heads
             elif "qkv/bias" in path:
-                spec[3] = m  # [S, L, 3, H, hd]
+                spec[ndim - 2] = m  # [..., 3, H, hd]
             elif "out/kernel" in path:
-                spec[2] = m  # [S, L, H, hd, d_model] -> row-parallel
+                spec[ndim - 3] = m  # [..., H, hd, d_model] -> row-parallel
             elif "up/kernel" in path:
-                spec[3] = m  # [S, L, d_model, d_ff] -> columns
+                spec[ndim - 1] = m  # [..., d_model, d_ff] -> columns
             elif "up/bias" in path:
-                spec[2] = m  # [S, L, d_ff]
+                spec[ndim - 1] = m  # [..., d_ff]
             elif "down/kernel" in path:
-                spec[2] = m  # [S, L, d_ff, d_model] -> row-parallel
+                spec[ndim - 2] = m  # [..., d_ff, d_model] -> row-parallel
             # out/bias, down/bias, layernorms: replicated over 'model'
         while spec and spec[-1] is None:
             spec.pop()
@@ -346,6 +390,8 @@ class PipelineParallel:
                 logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
             )
 
+        v = self.circular_chunks
+
         def body(state: TrainState, tokens, targets):
             idx = lax.axis_index(paxis)
             b, s = tokens.shape
@@ -356,32 +402,49 @@ class PipelineParallel:
             targets_mb = targets.reshape(M, mb, s)
 
             def loss_fn(params):
-                my_stage = jax.tree.map(lambda x: x[0], params["stages"])
+                # local shard: [1, v, lps, ...] -> chunk stack [v, lps, ...]
+                my_chunks = jax.tree.map(lambda x: x[0], params["stages"])
 
                 def tick(carry, t):
                     loss_sum, buf = carry
+                    # schedule decode: unit u = t - idx; groups of n_stages
+                    # microbatches run chunk c before the next group enters
+                    # (v=1 degenerates to GPipe: c == 0, m == u)
+                    u = t - idx
+                    active = jnp.logical_and(u >= 0, u < M * v)
+                    uc = jnp.clip(u, 0, M * v - 1)
+                    r = uc % (n_stages * v)
+                    c = r // n_stages
+                    m = (uc // (n_stages * v)) * n_stages + r % n_stages
                     toks = lax.dynamic_index_in_dim(
-                        tokens_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                        tokens_mb, m, 0, keepdims=False
                     )
-                    # embed is stage 0's job; elsewhere the ring buffer feeds
+                    # embed is (stage 0, chunk 0)'s job; elsewhere the ring
+                    # buffer feeds
                     h_in = lax.cond(
-                        idx == 0,
+                        jnp.logical_and(idx == 0, c == 0),
                         lambda: embed(params["pre"], toks),
                         lambda: buf,
                     )
-                    out = self._stage_apply(my_stage, h_in)
-                    # the microbatch EXITING the last stage this tick
-                    widx = jnp.clip(t - (n_stages - 1), 0, M - 1)
-                    valid = t >= (n_stages - 1)
-                    tgt = lax.dynamic_index_in_dim(
-                        targets_mb, widx, 0, keepdims=False
+                    stage = jax.tree.map(
+                        lambda x: lax.dynamic_index_in_dim(
+                            x, c, 0, keepdims=False
+                        ),
+                        my_chunks,
                     )
-                    # head + loss are the last stage's job, on valid ticks
-                    # only; the cond mask keeps exactly one backprop path
-                    # alive (a psum broadcast here would inflate grads by
-                    # n_stages via its summing transpose)
+                    out = self._stage_apply(stage, h_in)
+                    tgt = lax.dynamic_index_in_dim(
+                        targets_mb, m, 0, keepdims=False
+                    )
+                    # head + loss are (last stage, last chunk)'s job, on
+                    # active units only; the cond mask keeps exactly one
+                    # backprop path alive (a psum broadcast here would
+                    # inflate grads by n_stages via its summing transpose)
                     mb_loss = lax.cond(
-                        jnp.logical_and(idx == n_stages - 1, valid),
+                        jnp.logical_and(
+                            jnp.logical_and(idx == n_stages - 1, c == v - 1),
+                            active,
+                        ),
                         lambda: head_loss(params["post"], out, tgt) / M,
                         lambda: jnp.float32(0.0),
                     )
@@ -392,7 +455,8 @@ class PipelineParallel:
                     tick = jax.checkpoint(tick)
                 zero = jnp.zeros((mb, s, cfg.d_model), cfg.dtype)
                 (loss_sum, _), _ = lax.scan(
-                    tick, (jnp.float32(0.0), zero), jnp.arange(M + n_stages - 1)
+                    tick, (jnp.float32(0.0), zero),
+                    jnp.arange(M * v + n_stages - 1),
                 )
                 return loss_sum
 
@@ -440,8 +504,10 @@ class PipelineParallel:
     # -- parity helpers ------------------------------------------------------
 
     def merged_params(self, state: TrainState) -> dict:
+        # [n, v, lps, ...] -> chunk-major [v, n, lps, ...] -> flat [L, ...]
         stacked = jax.tree.map(
-            lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), state.params["stages"]
+            lambda x: np.asarray(x).swapaxes(0, 1).reshape(-1, *x.shape[3:]),
+            state.params["stages"],
         )
         return merge_transformer_params(
             jax.tree.map(np.asarray, state.params["pre"]),
