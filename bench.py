@@ -513,11 +513,16 @@ def main():
         elif args.metric == "pallas":
             result = bench_pallas(force_cpu=not usable)
         elif args.metric == "capacity":
+            shrunk = args.quick or not usable
             result = bench_capacity(
-                args.image_size if not (args.quick or not usable) else 256,
+                args.image_size if not shrunk else 256,
                 args.dtype, force_cpu=not usable,
-                max_batch=8 if (args.quick or not usable) else 512,
+                max_batch=8 if shrunk else 512,
             )
+            if args.quick and usable:
+                # shrunken shapes: the A5000-baseline ratio is meaningless
+                result["degraded"] = ("--quick shrank image_size/probe cap; "
+                                      "vs_baseline not comparable")
         else:
             result = bench_seq_scaling(
                 force_cpu=not usable, quick=args.quick or not usable

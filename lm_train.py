@@ -80,6 +80,7 @@ def train(args):
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
         dtype=dtype, remat=args.remat,
         n_experts=(n if args.parallelism == "ep" else 0),
+        router_top_k=args.router_top_k,
     )
     tx = optax.adam(args.lr)
     rng = jax.random.key(0)
@@ -193,6 +194,8 @@ def main():
     parser.add_argument("--circular-chunks", type=int, default=1,
                         help="pp/3d: layer chunks per stage (v>1 = circular "
                              "schedule, bubble ~v x smaller)")
+    parser.add_argument("--router-top-k", type=int, default=1,
+                        help="ep only: 1 = Switch top-1, 2 = GShard top-2")
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="fp32")
     parser.add_argument("--attn", choices=["ring", "ulysses", "flash_ring"],

@@ -21,7 +21,7 @@ def _args(**over):
         parallelism="dp", devices=4, steps=24, batch=4, seq_len=32, vocab=16,
         d_model=16, n_heads=2, n_layers=2, d_ff=32, lr=1e-2, microbatches=2,
         log_every=8, dtype="fp32", attn="ring", flash=False, remat=False,
-        force_cpu=False, dp=1, circular_chunks=1,
+        force_cpu=False, dp=1, circular_chunks=1, router_top_k=1,
     )
     base.update(over)
     return argparse.Namespace(**base)
@@ -41,6 +41,10 @@ def test_pp_trains(devices):
 def test_pp_circular_trains(devices):
     lm_train.train(_args(parallelism="pp", n_layers=8, devices=4,
                          microbatches=4, circular_chunks=2))
+
+
+def test_ep_top2_trains(devices):
+    lm_train.train(_args(parallelism="ep", router_top_k=2))
 
 
 def test_tp_composes_with_dp(devices):

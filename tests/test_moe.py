@@ -56,6 +56,68 @@ def test_moe_top1_math_with_ample_capacity():
         np.testing.assert_allclose(np.asarray(y[0, t]), np.asarray(expected), atol=1e-5)
 
 
+def test_moe_top2_math_with_ample_capacity():
+    """GShard-style top-2: output must equal the normalized-gate mix of the
+    two chosen experts' FFNs, computed by hand."""
+    cfg = TransformerConfig(d_model=8, d_ff=16, n_experts=4,
+                            capacity_factor=8.0, router_top_k=2)
+    layer = MoeMlp(cfg)
+    x = jax.random.normal(jax.random.key(7), (1, 6, 8))
+    variables = layer.init(jax.random.key(8), x)
+    y = layer.apply(variables, x)
+
+    p = variables["params"]
+    logits = x @ p["router"]["kernel"] + p["router"]["bias"]
+    probs = jax.nn.softmax(logits, -1)[0]  # [S, E]
+    import flax.linen as nn
+
+    for t in range(6):
+        vals, idx = jax.lax.top_k(probs[t], 2)
+        gates = vals / vals.sum()
+        expected = sum(
+            float(gates[j]) * (
+                nn.gelu(x[0, t] @ p["w_up"][int(idx[j])]) @ p["w_down"][int(idx[j])]
+            )
+            for j in range(2)
+        )
+        np.testing.assert_allclose(
+            np.asarray(y[0, t]), np.asarray(expected), atol=1e-5
+        )
+
+
+def test_moe_top2_first_choices_have_priority():
+    """Choice-major capacity: with capacity for half the tokens, every
+    token's FIRST choice gets a slot before any second choice does — so
+    second-choice dispatch only appears in experts with spare capacity."""
+    cfg = TransformerConfig(d_model=8, d_ff=16, n_experts=2,
+                            capacity_factor=1.0, router_top_k=2)
+    layer = MoeMlp(cfg)
+    x = jax.random.normal(jax.random.key(9), (1, 8, 8))
+    variables = layer.init(jax.random.key(10), x)
+    # E=2, K=2: every token picks both experts; capacity = 1.0*8/2 = 4 per
+    # expert, demand = 8 firsts + 8 seconds over 2*4=8 slots. All slots
+    # must go to first choices.
+    p = variables["params"]
+    logits = x @ p["router"]["kernel"] + p["router"]["bias"]
+    first = np.asarray(jnp.argmax(jax.nn.softmax(logits, -1), -1))[0]  # [S]
+    n_first_e0 = int((first == 0).sum())
+    if n_first_e0 in (0, 8):
+        pytest.skip("degenerate routing draw; all firsts on one expert")
+    # run and check: each token kept iff its first choice had a free slot
+    # (first-come within the sequence), never via its second choice when
+    # that expert was already full of firsts... simplest sufficient check:
+    # total kept (nonzero outputs) == total capacity filled by firsts when
+    # firsts saturate an expert
+    y = np.asarray(layer.apply(variables, x))
+    kept = (np.abs(y[0]).sum(-1) > 1e-7)
+    # every token whose first choice queue position < 4 must be kept
+    pos = {0: 0, 1: 0}
+    for t in range(8):
+        if pos[first[t]] < 4:
+            assert kept[t], f"token {t} (first choice {first[t]}) dropped"
+        pos[first[t]] += 1
+
+
 def test_moe_capacity_overflow_drops_tokens():
     """capacity_factor small: tokens past capacity get zero output (they
     ride the residual in a Block)."""

@@ -38,6 +38,9 @@ class TransformerConfig:
     # MoE: 0 experts = dense MLP everywhere; >0 = MoE MLP in every block
     n_experts: int = 0
     capacity_factor: float = 2.0
+    # 1 = Switch top-1 (gate = router prob); >1 = GShard-style top-k with
+    # normalized gates and choice-major capacity priority
+    router_top_k: int = 1
     # rematerialize each block's activations in backward (jax.checkpoint):
     # trades recompute FLOPs for O(n_layers) less activation memory — the
     # TPU-first long-context memory lever (HBM, not sequence sharding)

@@ -9,13 +9,19 @@ and the dispatched tensor's expert dim over an 'expert' mesh axis
 (PjitEngine rule ``("w_(up|down)", P("expert", None, None))``) and XLA
 inserts the all-to-alls that route tokens to their expert's device.
 
-Top-1 (Switch Transformer) routing with per-sequence capacity
-C = capacity_factor * S / E: overflow tokens pass through the residual
-(their combine weights are zero), the standard TPU-friendly static-shape
-treatment — no data-dependent shapes, everything MXU-shaped einsums.
+Routing is top-k with per-sequence capacity C = capacity_factor * S / E:
+k=1 is Switch Transformer (combine weight = the router probability
+itself), k>1 is GShard-style (gates = the top-k probabilities normalized
+to sum to 1; capacity is granted choice-major — every token's first
+choice queues before any second choice, so a 2nd choice never evicts a
+1st). Overflow tokens pass through the residual (their combine weights
+are zero) — the standard TPU-friendly static-shape treatment: no
+data-dependent shapes, everything MXU-shaped einsums.
 
-The router also exposes its load-balancing auxiliary loss (Switch eq. 4)
-via ``self.sow("aux_loss", ...)`` for engines that want to add it.
+The router also exposes its load-balancing auxiliary loss (Switch eq. 4,
+computed over first choices) via ``self.sow("aux_loss", ...)`` for
+engines that want to add it; PjitEngine(task="lm") folds it into the
+objective with ``aux_weight``.
 """
 
 from __future__ import annotations
@@ -38,6 +44,11 @@ class MoeMlp(nn.Module):
         e = cfg.n_experts
         if e <= 0:
             raise ValueError("MoeMlp needs config.n_experts > 0")
+        if not 1 <= cfg.router_top_k <= e:
+            raise ValueError(
+                f"router_top_k must be in [1, n_experts={e}], "
+                f"got {cfg.router_top_k}"
+            )
         b, s, d = x.shape
         capacity = max(1, int(cfg.capacity_factor * s / e))
 
@@ -46,21 +57,33 @@ class MoeMlp(nn.Module):
             x.astype(jnp.float32)
         )  # [B,S,E]
         probs = jnp.asarray(jax.nn.softmax(gate_logits, axis=-1))
-        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
-        gate = jnp.max(probs, axis=-1)  # [B,S]
+        k = cfg.router_top_k
+        top_vals, top_idx = jax.lax.top_k(probs, k)  # [B,S,K]
+        # Switch (k=1): gate = the raw router prob; GShard (k>1): top-k
+        # gates renormalized so kept tokens mix to weight ~1
+        gates = top_vals if k == 1 else (
+            top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+        )
 
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B,S,E]
-        # position of each token in its expert's queue (per sequence)
-        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [B,S,E], -1 if not routed
+        onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [B,S,K,E]
+        # capacity positions, CHOICE-MAJOR: flatten [K,S] with choice as
+        # the slow axis so every 1st choice queues before any 2nd choice,
+        # then cumulative-count per expert (per sequence)
+        oh_km = onehot.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+        pos_km = jnp.cumsum(oh_km, axis=1) * oh_km - 1.0  # -1 if not routed
+        pos = pos_km.reshape(b, k, s, e).transpose(0, 2, 1, 3)  # [B,S,K,E]
         in_capacity = (pos >= 0) & (pos < capacity)
         pos_onehot = jax.nn.one_hot(
-            jnp.where(in_capacity, pos, -1), capacity, dtype=jnp.float32
-        )  # [B,S,E,C] (all-zero row for dropped/unrouted)
-        dispatch = onehot[..., None] * pos_onehot  # [B,S,E,C]
-        combine = dispatch * gate[..., None, None]  # [B,S,E,C]
+            jnp.where(in_capacity, pos, -1.0).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )  # [B,S,K,E,C] (all-zero row for dropped/unrouted)
+        dispatch_k = onehot[..., None] * pos_onehot  # [B,S,K,E,C]
+        dispatch = dispatch_k.sum(2)  # [B,S,E,C] — positions are disjoint
+        combine = (dispatch_k * gates[..., None, None]).sum(2)  # [B,S,E,C]
 
-        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
-        frac_tokens = jnp.mean(onehot, axis=(0, 1))  # [E]
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e,
+        # f_e over FIRST choices (the GShard convention for k>1)
+        frac_tokens = jnp.mean(onehot[:, :, 0], axis=(0, 1))  # [E]
         frac_probs = jnp.mean(probs, axis=(0, 1))  # [E]
         self.sow("aux_loss", "load_balance", e * jnp.sum(frac_tokens * frac_probs))
 
