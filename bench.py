@@ -397,6 +397,117 @@ def bench_seq_scaling(force_cpu: bool, seq_lens=None, devices_wanted: int = 4,
     return result
 
 
+def bench_lm(force_cpu: bool, quick: bool = False) -> dict:
+    """Transformer-LM training throughput (tokens/sec + MFU) on one device:
+    the long-context model family's headline number, with the Pallas flash
+    attention kernel on the hot path and the same fetch-synced differential
+    timing + FLOP cross-check as the ConvNet bench."""
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    if force_cpu:
+        ensure_devices(1, force_cpu=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+    from tpu_sandbox.ops.pallas_attention import flash_attention_fn
+    from tpu_sandbox.train import TrainState
+    from tpu_sandbox.utils.flops import mfu as mfu_check, transformer_flops
+    from tpu_sandbox.utils.profiling import measure_per_step
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if quick:
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=2,
+                                n_layers=2, d_ff=128, max_len=256,
+                                dtype=jnp.float32)
+        batch, seq, steps = 2, 128, 3
+    else:
+        cfg = TransformerConfig(vocab_size=32768, d_model=1024, n_heads=8,
+                                n_layers=12, d_ff=4096, max_len=2048,
+                                dtype=jnp.bfloat16, remat=True)
+        batch, seq, steps = 8, 2048, 5
+    attn = flash_attention_fn() if on_tpu else None
+    model = TransformerLM(cfg, attention_fn=attn)
+    tx = optax.adamw(3e-4)
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, seq), jnp.int32), tx
+    )
+
+    def loss_fn(params, tokens, targets):
+        logits = model.apply({"params": params}, tokens)
+        return cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+        )
+
+    @jax.jit
+    def step(state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt,
+        ), loss
+
+    rng = np.random.default_rng(0)
+    staged = []
+    for _ in range(4):
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32
+        )
+        staged.append((toks, (toks + 1) % cfg.vocab_size))
+
+    def run(k):
+        nonlocal state
+        loss = None
+        for i in range(k):
+            t, tg = staged[i % len(staged)]
+            state, loss = step(state, t, tg)
+        return loss
+
+    timing = measure_per_step(run, steps)
+    spt = timing["sec_per_step"]
+    tokens_per_step = batch * seq
+    flops = transformer_flops(
+        cfg.n_layers, cfg.d_model, cfg.d_ff, seq, cfg.vocab_size
+    )["train"] * tokens_per_step
+    util = mfu_check(flops, spt if spt > 0 else 1.0,
+                     str(jax.devices()[0].device_kind))
+    result = {
+        "metric": "lm_train_tokens_per_sec",
+        "value": round(tokens_per_step / spt, 1) if spt > 0 else 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # reference has no LM at all (SURVEY §2.2)
+        "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                   "d_ff": cfg.d_ff, "seq": seq, "batch": batch,
+                   "vocab": cfg.vocab_size,
+                   "dtype": str(cfg.dtype.__name__ if hasattr(cfg.dtype, "__name__")
+                                else cfg.dtype),
+                   "flash_attention": bool(attn), "remat": cfg.remat},
+        "sec_per_step": spt,
+        "timing_method": timing["timing_method"],
+        "flops_per_step_model": flops,
+        "achieved_tflops": round(util["achieved_tflops"], 2),
+        "peak_tflops_bf16": util["peak_tflops_bf16"],
+        "mfu": round(util["mfu"], 4) if util["mfu"] is not None else None,
+        "device_kind": str(jax.devices()[0].device_kind),
+    }
+    if spt <= 0:
+        result.update(value=0.0, achieved_tflops=0.0, mfu=None)
+        result["degraded"] = (
+            f"non-positive differential step time ({spt:.6f}s)"
+        )
+    elif not util["plausible"]:
+        result.update(value=0.0)
+        result["degraded"] = (
+            f"implausible mfu {util['mfu']:.2f}; number untrusted"
+        )
+    return result
+
+
 def bench_pallas(force_cpu: bool) -> dict:
     """Compile-and-run the Pallas kernels on the real device and compare
     against the jnp reference — the driver-visible Mosaic-lowering check
@@ -487,7 +598,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
                    choices=["images_per_sec", "allreduce_bw", "pallas",
-                            "capacity", "seq_scaling"],
+                            "capacity", "seq_scaling", "lm"],
                    default="images_per_sec",
                    help="which benchmark to run (driver default: images/sec)")
     p.add_argument("--image-size", type=int, default=3000)
@@ -523,6 +634,9 @@ def main():
                 # shrunken shapes: the A5000-baseline ratio is meaningless
                 result["degraded"] = ("--quick shrank image_size/probe cap; "
                                       "vs_baseline not comparable")
+        elif args.metric == "lm":
+            result = bench_lm(force_cpu=not usable,
+                              quick=args.quick or not usable)
         else:
             result = bench_seq_scaling(
                 force_cpu=not usable, quick=args.quick or not usable
