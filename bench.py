@@ -637,6 +637,9 @@ def main():
         elif args.metric == "lm":
             result = bench_lm(force_cpu=not usable,
                               quick=args.quick or not usable)
+            if args.quick and usable:
+                result["degraded"] = ("--quick shrank the model; not the "
+                                      "headline LM config")
         else:
             result = bench_seq_scaling(
                 force_cpu=not usable, quick=args.quick or not usable
