@@ -1,0 +1,147 @@
+"""ConvNetS2D == ConvNet: the space-to-depth plan is the same function.
+
+The s2d model exists purely as an execution plan (models/convnet_s2d.py);
+these tests pin the contract that lets bench.py and the entry scripts swap
+it in for the reference-parity ConvNet: identical parameter tree, identical
+forward, identical gradients, identical batch-stats evolution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpu_sandbox.models import ConvNet
+from tpu_sandbox.models.convnet_s2d import ConvNetS2D, scatter_kernel
+from tpu_sandbox.ops.losses import cross_entropy_loss
+
+
+def _models(use_bn=True, dtype=jnp.float32):
+    return (ConvNet(use_bn=use_bn, dtype=dtype),
+            ConvNetS2D(use_bn=use_bn, dtype=dtype))
+
+
+def _data(n=3, hw=48, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, hw, hw, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n,)), jnp.int32)
+    return x, y
+
+
+def test_param_trees_compatible():
+    ref, s2d = _models()
+    x, _ = _data()
+    vr = ref.init(jax.random.key(0), x)
+    vs = s2d.init(jax.random.key(0), x)
+    ref_shapes = jax.tree.map(jnp.shape, vr)
+    s2d_shapes = jax.tree.map(jnp.shape, vs)
+    assert ref_shapes == s2d_shapes
+
+
+def test_scatter_kernel_reproduces_conv():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((5, 5, 1, 3)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16)), jnp.float32)
+    ref = jax.lax.conv_general_dilated(
+        x[..., None], w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    from tpu_sandbox.models.convnet_s2d import space_to_depth
+    out = jax.lax.conv_general_dilated(
+        space_to_depth(x, 4), scatter_kernel(w, 4), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # undo s2d on the output: channel (a*4+b)*3+co at block (i,j)
+    n, hb, wb, _ = out.shape
+    out = out.reshape(n, hb, wb, 4, 4, 3).transpose(0, 1, 3, 2, 4, 5)
+    out = out.reshape(n, hb * 4, wb * 4, 3)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("use_bn", [True, False])
+def test_forward_matches_convnet(use_bn):
+    ref, s2d = _models(use_bn)
+    x, _ = _data()
+    variables = ref.init(jax.random.key(0), x)
+    if use_bn:
+        lr = ref.apply(variables, x, train=True, mutable=["batch_stats"])
+        ls = s2d.apply(variables, x, train=True, mutable=["batch_stats"])
+        out_r, out_s = lr[0], ls[0]
+    else:
+        out_r = ref.apply(variables, x, train=True)
+        out_s = s2d.apply(variables, x, train=True)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r),
+                               atol=2e-4)
+    if use_bn:
+        for k in ("bn1", "bn2"):
+            for stat in ("mean", "var"):
+                np.testing.assert_allclose(
+                    np.asarray(ls[1]["batch_stats"][k][stat]),
+                    np.asarray(lr[1]["batch_stats"][k][stat]),
+                    atol=1e-5, err_msg=f"{k}/{stat}")
+
+
+def test_eval_mode_uses_running_stats():
+    ref, s2d = _models()
+    x, _ = _data()
+    variables = ref.init(jax.random.key(0), x)
+    out_r = ref.apply(variables, x, train=False)
+    out_s = s2d.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r),
+                               atol=2e-4)
+
+
+def test_gradients_match_convnet():
+    ref, s2d = _models()
+    x, y = _data()
+    variables = ref.init(jax.random.key(0), x)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(model):
+        def f(p):
+            logits, _ = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits, y)
+        return f
+
+    lr, gr = jax.value_and_grad(loss_fn(ref))(params)
+    ls, gs = jax.value_and_grad(loss_fn(s2d))(params)
+    np.testing.assert_allclose(ls, lr, atol=1e-5)
+    flat_r = jax.tree_util.tree_leaves_with_path(gr)
+    flat_s = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree_util.tree_leaves_with_path(gs)}
+    for k, v in flat_r:
+        np.testing.assert_allclose(
+            np.asarray(flat_s[jax.tree_util.keystr(k)]), np.asarray(v),
+            atol=5e-4, err_msg=jax.tree_util.keystr(k))
+
+
+def test_short_training_runs_stay_together():
+    """5 SGD steps from shared init: losses track to float tolerance."""
+    ref, s2d = _models()
+    x, y = _data(n=4, hw=32)
+    tx = optax.sgd(1e-2)
+    variables = ref.init(jax.random.key(0), x)
+
+    def run(model):
+        params, stats = variables["params"], variables["batch_stats"]
+        opt = tx.init(params)
+        losses = []
+        for _ in range(5):
+            def f(p):
+                logits, upd = model.apply(
+                    {"params": p, "batch_stats": stats}, x, train=True,
+                    mutable=["batch_stats"],
+                )
+                return cross_entropy_loss(logits, y), upd
+            (loss, upd), g = jax.value_and_grad(f, has_aux=True)(params)
+            stats = upd["batch_stats"]
+            updates, opt = tx.update(g, opt, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run(s2d), run(ref), rtol=1e-4)

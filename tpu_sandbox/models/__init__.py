@@ -1,1 +1,14 @@
 from tpu_sandbox.models.convnet import ConvNet  # noqa: F401
+from tpu_sandbox.models.convnet_s2d import ConvNetS2D  # noqa: F401
+
+
+def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
+    """The execution-plan switch: ConvNetS2D (space-to-depth, the TPU fast
+    path — see models/convnet_s2d.py) when the plan applies, else the plain
+    ConvNet. Both are the same function (tests/test_convnet_s2d.py)."""
+    h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
+    if plan == "plain":
+        return ConvNet(**kwargs)
+    if plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0):
+        return ConvNetS2D(**kwargs)
+    return ConvNet(**kwargs)

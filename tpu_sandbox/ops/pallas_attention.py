@@ -125,7 +125,13 @@ def _fwd_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _emit():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+        # lane-broadcast row stats: Mosaic requires the last two block dims
+        # to be (8k, 128m)-aligned, so lse is carried as [block_q, LANE]
+        # (the official TPU flash kernel's MIN_BLOCK_SIZE convention) and
+        # sliced back to a row outside the kernel
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(l), lse_ref.shape[2:]
+        ).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
@@ -155,11 +161,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
     smem = functools.partial(pl.BlockSpec, (1, 1),
                              lambda b, h, i, j: (0, 0),
                              memory_space=pltpu.SMEM)
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, _LANE), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -171,7 +177,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
         ],
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, _LANE),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max m
@@ -184,6 +191,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, kv_len,
         ),
         interpret=interpret,
     )(*offs, q, k, v)
+    return out, lse[..., 0]
 
 
 def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
@@ -231,14 +239,14 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
         valid = k_pos < kv_off_ref[0, 0] + kv_len
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
-        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=f32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=f32
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=f32
         )
@@ -287,11 +295,11 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
         valid = k_pos < kv_off_ref[0, 0] + kv_len
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
-        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=f32
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=f32
         )
@@ -315,6 +323,10 @@ def _flash_bwd(q, k, v, delta, lse, g, scale, causal, block_q, block_k,
     interpret = default_interpret(interpret)
     b, h, s, d = q.shape
     sk = k.shape[2]
+    # row stats enter lane-broadcast ([B,H,S] -> [B,H,S,LANE]) for the same
+    # Mosaic block-alignment reason the forward emits lse that way
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     offs = [jnp.asarray(x, jnp.int32).reshape(1, 1)
             for x in (q_offset, kv_offset)]
     smem = functools.partial(pl.BlockSpec, (1, 1),
@@ -331,11 +343,11 @@ def _flash_bwd(q, k, v, delta, lse, g, scale, causal, block_q, block_k,
     qspec = functools.partial(spec, block_q)
     kspec = functools.partial(spec, block_k)
 
-    def rowspec(pos):  # lse/delta [B, H, S] blocks
+    def rowspec(pos):  # lse/delta [B, H, S, LANE] lane-broadcast blocks
         return pl.BlockSpec(
-            (1, 1, block_q),
-            (lambda b, h, x, y: (b, h, x)) if pos == 2
-            else (lambda b, h, x, y: (b, h, y)),
+            (1, 1, block_q, _LANE),
+            (lambda b, h, x, y: (b, h, x, 0)) if pos == 2
+            else (lambda b, h, x, y: (b, h, y, 0)),
         )
 
     params = dict(scale=scale, causal=causal, block_q=block_q,
