@@ -505,7 +505,8 @@ def bench_lm(force_cpu: bool, quick: bool = False) -> dict:
     else:
         cfg = TransformerConfig(vocab_size=32768, d_model=1024, n_heads=8,
                                 n_layers=12, d_ff=4096, max_len=2048,
-                                dtype=jnp.bfloat16, remat=True)
+                                dtype=jnp.bfloat16, remat=True,
+                                remat_policy="dots")
         batch, seq, steps = 8, 2048, 5
     attn = flash_attention_fn() if on_tpu else None
     model = TransformerLM(cfg, attention_fn=attn)
@@ -564,7 +565,8 @@ def bench_lm(force_cpu: bool, quick: bool = False) -> dict:
                    "vocab": cfg.vocab_size,
                    "dtype": str(cfg.dtype.__name__ if hasattr(cfg.dtype, "__name__")
                                 else cfg.dtype),
-                   "flash_attention": bool(attn), "remat": cfg.remat},
+                   "flash_attention": bool(attn), "remat": cfg.remat,
+                   "remat_policy": cfg.remat_policy},
         "sec_per_step": spt,
         "timing_method": timing["timing_method"],
         "flops_per_step_model": flops,
@@ -746,7 +748,8 @@ def main():
         print(json.dumps(result))
         return
     if args.quick:
-        result = bench(128, 2, 3, 1, "fp32", True, args.baseline)
+        result = bench(128, 2, 3, 1, "fp32", True, args.baseline,
+                       plan=args.plan)
     elif args.probe_timeout and not accelerator_usable(args.probe_timeout):
         # accelerator wedged/absent: report an honest degraded-mode number
         # rather than hanging the driver (or taking hours at 3000x3000 on

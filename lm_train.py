@@ -79,6 +79,7 @@ def train(args):
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
         dtype=dtype, remat=args.remat,
+        remat_policy=getattr(args, "remat_policy", "full"),
         n_experts=(n if args.parallelism == "ep" else 0),
         router_top_k=args.router_top_k,
     )
@@ -204,6 +205,11 @@ def main():
                              "head/seq swap, or the Pallas flash-ring")
     parser.add_argument("--flash", action="store_true",
                         help="use the Pallas flash-attention kernel")
+    parser.add_argument("--remat-policy", choices=["full", "dots"],
+                        default="full",
+                        help="remat=full recomputes whole blocks; dots "
+                             "saves matmul outputs (checkpoint_dots) so "
+                             "backward pays no extra MXU FLOPs")
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint each block (memory for FLOPs)")
     parser.add_argument("--force-cpu", action="store_true")

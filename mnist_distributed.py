@@ -47,7 +47,7 @@ def train(args, world_size):
     import optax
 
     from tpu_sandbox.data import ShardedBatchLoader
-    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.models import pick_convnet
     from tpu_sandbox.parallel import DataParallel
     from tpu_sandbox.runtime import bootstrap
     from tpu_sandbox.runtime.mesh import make_mesh
@@ -61,7 +61,8 @@ def train(args, world_size):
     rng = jax.random.key(0)  # parity: torch.manual_seed(0), reference :51
     image_shape = [args.image_size, args.image_size]
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    model = ConvNet(num_classes=10, dtype=dtype)
+    model = pick_convnet(args.image_size, plan=args.plan,
+                         num_classes=10, dtype=dtype)
     tx = optax.sgd(learning_rate=1e-4)  # reference :65
 
     images, labels = load_training_arrays(args, world_size)
@@ -137,7 +138,7 @@ def train_multiprocess_worker(args, world_size):
 
     from tpu_sandbox.data import BatchLoader
     from tpu_sandbox.data.sampler import DistributedSampler
-    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.models import pick_convnet
     from tpu_sandbox.parallel import DataParallel
     from tpu_sandbox.runtime.mesh import make_mesh
     from tpu_sandbox.runtime.multihost import global_batch_from_local
@@ -149,7 +150,8 @@ def train_multiprocess_worker(args, world_size):
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
 
     # same seed everywhere -> same init; shard_state places it replicated
-    model = ConvNet(num_classes=10, dtype=dtype)
+    model = pick_convnet(args.image_size, plan=args.plan,
+                         num_classes=10, dtype=dtype)
     tx = optax.sgd(learning_rate=1e-4)
     state = TrainState.create(
         model, jax.random.key(0), jnp.zeros([1, *image_shape, 1], dtype), tx
@@ -214,6 +216,7 @@ def spawn_multiprocess(args, world_size):
         "--image-size", str(args.image_size),
         "--synthetic-n", str(args.synthetic_n),
         "--log-every", str(args.log_every), "--dtype", args.dtype,
+        "--plan", args.plan,
     ]
     if args.data_dir:
         passthrough += ["--data-dir", args.data_dir]
@@ -287,6 +290,12 @@ def main():
     parser.add_argument("--synthetic-n", type=int, default=60000)
     parser.add_argument("--limit-steps", type=int, default=None)
     parser.add_argument("--log-every", type=int, default=100)
+    parser.add_argument("--plan", choices=["auto", "s2d", "plain"],
+                        default="auto",
+                        help="ConvNet execution plan: s2d = space-to-depth "
+                             "TPU fast path (models/convnet_s2d.py, same "
+                             "function as the plain net - tested); auto "
+                             "picks s2d when the image size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
     parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
                         help="with --ckpt-dir: also save every N steps")

@@ -38,13 +38,14 @@ def train(device_index, args):
 
     from tpu_sandbox.data import BatchLoader, load_mnist, synthetic_mnist
     from tpu_sandbox.data.mnist import normalize
-    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.models import pick_convnet
     from tpu_sandbox.train import Trainer, TrainState, make_train_step
 
     rng = jax.random.key(0)  # parity: torch.manual_seed(0), reference :35
     image_shape = [args.image_size, args.image_size]
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    model = ConvNet(num_classes=10, dtype=dtype)
+    model = pick_convnet(args.image_size, plan=args.plan,
+                         num_classes=10, dtype=dtype)
     tx = optax.sgd(learning_rate=1e-4)  # reference :49, no momentum
 
     try:
@@ -147,6 +148,12 @@ def main():
                              "sequential microbatches (OOM workaround on ONE "
                              "device — the counterpart of the reference's "
                              "DDP batch split, README OOM experiment)")
+    parser.add_argument("--plan", choices=["auto", "s2d", "plain"],
+                        default="auto",
+                        help="ConvNet execution plan: s2d = space-to-depth "
+                             "TPU fast path (models/convnet_s2d.py, same "
+                             "function as the plain net - tested); auto "
+                             "picks s2d when the image size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16",
                         help="compute dtype; params and loss stay fp32")
     parser.add_argument("--native-loader", action="store_true",

@@ -29,6 +29,14 @@ def _data(n=3, hw=48, seed=0):
     return x, y
 
 
+def test_pick_convnet_plan_switch():
+    from tpu_sandbox.models import pick_convnet
+    assert type(pick_convnet(3000)).__name__ == "ConvNetS2D"
+    assert type(pick_convnet(3000, plan="plain")).__name__ == "ConvNet"
+    assert type(pick_convnet(3001)).__name__ == "ConvNet"  # not 4-divisible
+    assert type(pick_convnet((128, 64))).__name__ == "ConvNetS2D"
+
+
 def test_param_trees_compatible():
     ref, s2d = _models()
     x, _ = _data()

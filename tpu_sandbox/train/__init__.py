@@ -1,2 +1,6 @@
 from tpu_sandbox.train.state import TrainState  # noqa: F401
-from tpu_sandbox.train.trainer import Trainer, make_train_step  # noqa: F401
+from tpu_sandbox.train.trainer import (  # noqa: F401
+    Trainer,
+    make_train_step,
+    resize_on_device,
+)

@@ -33,7 +33,7 @@ from tpu_sandbox.ops.losses import cross_entropy_loss
 from tpu_sandbox.train.state import TrainState
 
 
-def _resize_on_device(images, image_size):
+def resize_on_device(images, image_size):
     """[N,h,w,C] -> [N,H,W,C] bilinear, channel-layout safe: a size-1
     channel is squeezed around the resize so no [N,H,W,1] intermediate is
     laid out with the degenerate dim on the 128-wide lane axis (XLA:TPU
@@ -77,7 +77,7 @@ def make_train_step(
 
     def loss_fn(params, batch_stats, images, labels):
         if image_size is not None:
-            images = _resize_on_device(images, image_size)
+            images = resize_on_device(images, image_size)
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
@@ -140,7 +140,7 @@ def make_eval_step(model, *, image_size: tuple[int, int] | None = None) -> Calla
     @jax.jit
     def eval_step(state: TrainState, images: jax.Array, labels: jax.Array):
         if image_size is not None:
-            images = _resize_on_device(images, image_size)
+            images = resize_on_device(images, image_size)
         logits = model.apply(state.variables(), images, train=False)
         loss = cross_entropy_loss(logits, labels)
         correct = jnp.sum(jnp.argmax(logits, -1) == labels)
