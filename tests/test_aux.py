@@ -110,3 +110,16 @@ def test_pallas_ce_large_vocab_block_grid():
         float(cross_entropy_loss(logits, labels)),
         rtol=1e-6,
     )
+
+
+def test_bench_is_oom_matcher():
+    """bench._is_oom must catch every allocator-failure phrasing seen in the
+    wild: PJRT RESOURCE_EXHAUSTED, generic OOM, and the axon remote
+    compiler's AOT 'would exceed memory'."""
+    import bench
+
+    assert bench._is_oom("RESOURCE_EXHAUSTED: out of memory allocating")
+    assert bench._is_oom("XlaRuntimeError: Allocation (size=18432000000) "
+                         "would exceed memory (size=17179869184)")
+    assert bench._is_oom("oom while allocating")
+    assert not bench._is_oom("ValueError: shapes do not match")

@@ -58,7 +58,12 @@ def main():
     p.add_argument("--n", type=int, default=3)
     p.add_argument("--stages", default="",
                    help="comma-separated subset to run (default: all)")
+    p.add_argument("--force-cpu", action="store_true",
+                   help="flip jax to the CPU backend (env vars alone cannot "
+                        "override the axon sitecustomize registration)")
     args = p.parse_args()
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
     b, hw, n = args.batch, args.size, args.n
     only = set(s for s in args.stages.split(",") if s)
 
@@ -132,6 +137,22 @@ def main():
         "fwd_conv1_grad": (lambda x: jax.grad(
             lambda xx: nhwc(xx, w1).astype(f32).sum())(x), x_big),
     }
+
+    # the space-to-depth plan's two convs (models/convnet_s2d.py): k3 on a
+    # 4x-coarser grid with fat channels — the lane-friendly replacements
+    from tpu_sandbox.models.convnet_s2d import scatter_kernel
+    x1s = arr(b, hw // 4, hw // 4, 16)
+    w1s = scatter_kernel(w1, 4)                       # [3,3,16,256]
+    x2s = arr(b, hw // 4, hw // 4, 64)
+    w2s = scatter_kernel(w2, 2)                       # [3,3,64,128]
+    stages.update({
+        "conv1_s2d": (lambda x: nhwc(x, w1s), x1s),
+        "conv2_s2d": (lambda x: nhwc(x, w2s), x2s),
+        "conv1_s2d_grad": (lambda x: jax.grad(
+            lambda xx: nhwc(xx, w1s).astype(f32).sum())(x), x1s),
+        "conv2_s2d_grad": (lambda x: jax.grad(
+            lambda xx: nhwc(xx, w2s).astype(f32).sum())(x), x2s),
+    })
 
     for name, (f, x0) in stages.items():
         if only and name not in only:
