@@ -80,7 +80,8 @@ def train(args, world_size):
         if ckpt.latest_step(args.ckpt_dir) is not None:
             state = ckpt.restore(args.ckpt_dir, state)
             print(f"resumed from step {int(state.step)}")
-    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape))
+    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
+                      zero=args.zero)
     dstate = dp.shard_state(state)
 
     def step(s, images_np, labels_np):
@@ -179,7 +180,8 @@ def train_multiprocess_worker(args, world_size):
                     global_batch_from_local(mesh, np.asarray(labs)),
                 )
 
-    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape))
+    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
+                      zero=args.zero)
     dstate = dp.shard_state(state)
     trainer = Trainer(dp.train_step, log_every=args.log_every, log_rank=0,
                       verbose=rank == 0)
@@ -222,6 +224,8 @@ def spawn_multiprocess(args, world_size):
         passthrough += ["--data-dir", args.data_dir]
     if args.limit_steps:
         passthrough += ["--limit-steps", str(args.limit_steps)]
+    if args.zero:
+        passthrough += ["--zero"]
     procs = [
         subprocess.Popen(cmd_base + ["--rank", str(r)] + passthrough)
         for r in range(world_size)
@@ -290,6 +294,9 @@ def main():
     parser.add_argument("--synthetic-n", type=int, default=60000)
     parser.add_argument("--limit-steps", type=int, default=None)
     parser.add_argument("--log-every", type=int, default=100)
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1: shard optimizer state over the data "
+                             "axis (same math, 1/N the optimizer memory)")
     parser.add_argument("--plan", choices=["auto", "s2d", "plain"],
                         default="auto",
                         help="ConvNet execution plan: s2d = space-to-depth "

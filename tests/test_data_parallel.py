@@ -148,3 +148,50 @@ def test_dp_training_loss_decreases(mesh8):
     final = trainer.fit(dp.shard_state(state), loader, epochs=4)
     assert np.mean(trainer.losses[-4:]) < np.mean(trainer.losses[:4]) * 0.9
     assert int(final.step) == 4 * len(loader)
+
+
+def test_zero1_matches_plain_dp(mesh8):
+    """ZeRO-1 (sharded optimizer state) is the same math as plain DP: with
+    AdamW (stateful, elementwise) the losses and final params agree to
+    float tolerance over several steps, while the big dim-0-divisible
+    optimizer moments actually live sharded across the axis."""
+    model = ConvNet(use_bn=False)
+    tx = optax.adamw(1e-3)
+    state0 = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx
+    )
+    images, labels = synthetic_mnist(n=16, seed=0)
+    images, labels = normalize(images), labels.astype("int32")
+
+    def run(zero):
+        dp = DataParallel(model, tx, mesh8, zero=zero, donate=False)
+        st = dp.shard_state(state0)
+        losses = []
+        for _ in range(3):
+            st, loss = dp.train_step(st, *dp.shard_batch(images, labels))
+            losses.append(np.asarray(loss))
+        return st, losses
+
+    st_plain, losses_plain = run(zero=False)
+    st_zero, losses_zero = run(zero=True)
+    np.testing.assert_allclose(
+        np.stack(losses_zero), np.stack(losses_plain), rtol=1e-5
+    )
+    for (kp, p), (_, z) in zip(
+        jax.tree_util.tree_leaves_with_path(st_plain.params),
+        jax.tree_util.tree_leaves_with_path(st_zero.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(z), np.asarray(p), atol=1e-6,
+            err_msg=jax.tree_util.keystr(kp),
+        )
+
+    # the fc kernel's Adam moments (dim0 = flattened features, divisible by
+    # 8) must be sharded over the data axis; conv kernels (dim0=5) must not
+    mu = st_zero.opt_state[0].mu
+    fc_mu = mu["fc"]["kernel"]
+    conv_mu = mu["conv1"]["kernel"]
+    fc_spec = fc_mu.sharding.spec
+    assert fc_spec and fc_spec[0] == "data", fc_spec
+    conv_spec = conv_mu.sharding.spec
+    assert not conv_spec or conv_spec[0] is None, conv_spec

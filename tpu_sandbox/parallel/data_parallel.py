@@ -55,8 +55,25 @@ class DataParallel:
         *,
         image_size: tuple[int, int] | None = None,
         average_loss: bool = False,
+        zero: bool = False,
         donate: bool = True,
     ):
+        """``zero=True`` is ZeRO-1 (optimizer-state sharding): optimizer
+        state lives sharded over the data axis (dim 0, leaves whose leading
+        dim divides the axis size; others stay replicated), each rank
+        updates only its parameter block, and the updated blocks are
+        all-gathered. Same math as plain DP — the update is elementwise per
+        parameter — with the optimizer memory (e.g. Adam's two moments)
+        divided by the axis size. This is the TPU spelling of DeepSpeed/
+        FSDP's optimizer-state sharding: the reduce/scatter/gather
+        choreography is just shardings + XLA collectives.
+
+        Contract: the transform must be ELEMENTWISE per parameter (sgd,
+        momentum, adam/adamw, ...). Transforms that couple parameters —
+        e.g. ``optax.clip_by_global_norm`` (a norm over ALL grads) — would
+        silently compute per-block norms; transforms whose state does not
+        mirror param shapes (e.g. adafactor's factored moments) are
+        rejected by a structural check at shard time."""
         if axis not in mesh.axis_names:
             raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
         self.model = model
@@ -66,18 +83,53 @@ class DataParallel:
         self.size = mesh.shape[axis]
         self.image_size = image_size
         self.average_loss = average_loss
+        self.zero = zero
         self._build(donate)
+
+    def _dim0_sharded(self, leaf) -> bool:
+        """ZeRO placement rule for one array: shard dim 0 iff it divides
+        the axis size (conv kernels with dim0=5 stay replicated; the fat
+        fc/Dense kernels and 1-D scales shard)."""
+        return (
+            hasattr(leaf, "ndim") and leaf.ndim >= 1
+            and leaf.shape[0] >= self.size and leaf.shape[0] % self.size == 0
+        )
 
     # -- state placement ----------------------------------------------------
 
     def _specs(self, state: TrainState) -> TrainState:
         """PartitionSpecs mirroring the state pytree: everything replicated
-        except batch-stats, which shard their (added) leading axis."""
+        except batch-stats, which shard their (added) leading axis — and,
+        under ZeRO-1, eligible optimizer-state leaves, which shard dim 0."""
+        if self.zero:
+            # structural guard for the elementwise contract: every sharded
+            # opt leaf must mirror some param's shape, else the blockwise
+            # tx.update would see mismatched operands (e.g. adafactor's
+            # factored moments) — fail loudly here instead
+            param_shapes = {
+                jnp.shape(p) for p in jax.tree.leaves(state.params)
+            }
+            bad = [
+                jnp.shape(x) for x in jax.tree.leaves(state.opt_state)
+                if self._dim0_sharded(x) and jnp.shape(x) not in param_shapes
+            ]
+            if bad:
+                raise ValueError(
+                    "zero=True needs an elementwise optimizer whose state "
+                    f"mirrors param shapes; found opt-state leaves {bad} "
+                    "matching no parameter (e.g. factored moments)"
+                )
+            opt_specs = jax.tree.map(
+                lambda x: P(self.axis) if self._dim0_sharded(x) else P(),
+                state.opt_state,
+            )
+        else:
+            opt_specs = jax.tree.map(lambda _: P(), state.opt_state)
         return TrainState(
             step=P(),
             params=jax.tree.map(lambda _: P(), state.params),
             batch_stats=jax.tree.map(lambda _: P(self.axis), state.batch_stats),
-            opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+            opt_state=opt_specs,
         )
 
     def shard_state(self, state: TrainState) -> TrainState:
@@ -134,6 +186,7 @@ class DataParallel:
     def _build(self, donate: bool) -> None:
         model, tx, axis = self.model, self.tx, self.axis
         image_size, average_loss = self.image_size, self.average_loss
+        zero, size, dim0_sharded = self.zero, self.size, self._dim0_sharded
 
         def loss_fn(params, batch_stats, images, labels):
             variables = {"params": params}
@@ -159,8 +212,39 @@ class DataParallel:
             # THE data-parallel step: mean grads across ranks. XLA overlaps
             # this with the rest of backprop (DDP's bucketing, compiled).
             grads = lax.pmean(grads, axis)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            if zero:
+                # ZeRO-1: update only this rank's dim-0 block of each
+                # eligible leaf (opt state arrived pre-sharded via in_specs),
+                # then all-gather the updated blocks. Elementwise optimizers
+                # make the math identical to the replicated update.
+                idx = lax.axis_index(axis)
+                sharded = jax.tree.map(dim0_sharded, state.params)
+
+                def blk(x):
+                    n = x.shape[0] // size
+                    return lax.dynamic_slice_in_dim(x, idx * n, n, 0)
+
+                params_blk = jax.tree.map(
+                    lambda p, s: blk(p) if s else p, state.params, sharded
+                )
+                grads_blk = jax.tree.map(
+                    lambda g, s: blk(g) if s else g, grads, sharded
+                )
+                updates, new_opt = tx.update(
+                    grads_blk, state.opt_state, params_blk
+                )
+                new_blk = optax.apply_updates(params_blk, updates)
+                new_params = jax.tree.map(
+                    lambda p, s: (
+                        lax.all_gather(p, axis, axis=0, tiled=True) if s else p
+                    ),
+                    new_blk, sharded,
+                )
+            else:
+                updates, new_opt = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates)
             if average_loss:
                 loss = lax.pmean(loss, axis)  # the reference's dead AVG reduce
             new_state = state.replace(
