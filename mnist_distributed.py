@@ -41,6 +41,23 @@ def load_training_arrays(args, world_size):
     return images, labels
 
 
+def make_optimizer(args):
+    """--opt picks the optimizer; the reference schedule is plain SGD(1e-4)
+    (mnist_distributed.py:65 in the reference), kept as the default for log
+    parity. --zero only has state to shard for the stateful choices."""
+    import optax
+
+    if args.opt == "sgd":
+        if args.zero:
+            print("note: --zero with plain SGD shards no optimizer state "
+                  "(SGD is stateless); use --opt momentum|adamw for the "
+                  "memory win")
+        return optax.sgd(learning_rate=1e-4)
+    if args.opt == "momentum":
+        return optax.sgd(learning_rate=1e-4, momentum=0.9)
+    return optax.adamw(learning_rate=1e-4)
+
+
 def train(args, world_size):
     import jax
     import jax.numpy as jnp
@@ -63,7 +80,7 @@ def train(args, world_size):
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     model = pick_convnet(args.image_size, plan=args.plan,
                          num_classes=10, dtype=dtype)
-    tx = optax.sgd(learning_rate=1e-4)  # reference :65
+    tx = make_optimizer(args)
 
     images, labels = load_training_arrays(args, world_size)
 
@@ -153,7 +170,7 @@ def train_multiprocess_worker(args, world_size):
     # same seed everywhere -> same init; shard_state places it replicated
     model = pick_convnet(args.image_size, plan=args.plan,
                          num_classes=10, dtype=dtype)
-    tx = optax.sgd(learning_rate=1e-4)
+    tx = make_optimizer(args)
     state = TrainState.create(
         model, jax.random.key(0), jnp.zeros([1, *image_shape, 1], dtype), tx
     )
@@ -218,7 +235,7 @@ def spawn_multiprocess(args, world_size):
         "--image-size", str(args.image_size),
         "--synthetic-n", str(args.synthetic_n),
         "--log-every", str(args.log_every), "--dtype", args.dtype,
-        "--plan", args.plan,
+        "--plan", args.plan, "--opt", args.opt,
     ]
     if args.data_dir:
         passthrough += ["--data-dir", args.data_dir]
@@ -294,6 +311,11 @@ def main():
     parser.add_argument("--synthetic-n", type=int, default=60000)
     parser.add_argument("--limit-steps", type=int, default=None)
     parser.add_argument("--log-every", type=int, default=100)
+    parser.add_argument("--opt", choices=["sgd", "momentum", "adamw"],
+                        default="sgd",
+                        help="optimizer (default: the reference's plain "
+                             "SGD 1e-4; momentum/adamw give --zero real "
+                             "state to shard)")
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1: shard optimizer state over the data "
                              "axis (same math, 1/N the optimizer memory)")

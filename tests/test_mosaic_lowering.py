@@ -1,0 +1,86 @@
+"""Mosaic (TPU) lowering checks for every Pallas kernel — WITHOUT a TPU.
+
+VERDICT r01 weak #7: interpret-mode tests can't see Mosaic lowering
+failures (r01's kernels indeed failed on the real chip with a block-shape
+constraint: the last two block dims must be (8k, 128m)-aligned or equal
+the array dims — caught only by the on-chip bench). Mosaic lowering runs
+at MLIR-lowering time, not execution time, so ``lower(lowering_platforms=
+("tpu",))`` on the CPU backend exercises the exact check that failed,
+machine-independent. These tests pin it for the fwd kernel, both backward
+kernels, the lse/partial variants the ring engines use, and the CE kernel,
+across the shape classes the bench exercises (block-aligned, non-multiple
+sequence lengths, bf16, head_dim below the lane width).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_sandbox.ops.losses import cross_entropy_loss  # noqa: F401 (parity)
+from tpu_sandbox.ops.pallas_attention import (
+    flash_attention,
+    flash_attention_lse,
+    make_flash_bwd_lse,
+)
+from tpu_sandbox.ops.pallas_ce import pallas_cross_entropy
+
+
+def _lower_tpu(fn, *args):
+    jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+@pytest.mark.parametrize(
+    "b,s,h,d,dt",
+    [
+        (2, 512, 4, 64, jnp.float32),
+        (2, 384, 4, 64, jnp.bfloat16),   # non-multiple-of-block S
+        (1, 1024, 8, 128, jnp.bfloat16),
+    ],
+)
+def test_flash_attention_fwd_bwd_lowers_for_tpu(b, s, h, d, dt):
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), dt)
+               for _ in range(3))
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, interpret=False)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_flash_lse_and_partial_bwd_lower_for_tpu():
+    """The ring engines' building blocks: forward-with-lse at unequal
+    q/kv lengths + the per-hop partial backward factory."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(1, 384, 2, 64)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(1, 384, 2, 64)), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, interpret=False,
+                                       kv_offset=128)
+        return out.astype(jnp.float32).sum() + lse.sum()
+
+    _lower_tpu(fwd, q, k, v)
+
+    def partial_bwd(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, interpret=False)
+        g = jnp.ones_like(out)
+        fn = make_flash_bwd_lse(q, out.astype(q.dtype), g.astype(q.dtype),
+                                lse, interpret=False)
+        dq, dk, dv = fn(k, v, 0)
+        return dq.sum() + dk.sum() + dv.sum()
+
+    _lower_tpu(partial_bwd, q, k, v)
+
+
+def test_pallas_ce_lowers_for_tpu():
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(64, 32000)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 32000, size=(64,)), jnp.int32)
+    _lower_tpu(
+        lambda lg, lb: pallas_cross_entropy(lg, lb, interpret=False),
+        logits, labels,
+    )

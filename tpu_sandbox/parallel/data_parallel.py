@@ -209,14 +209,14 @@ class DataParallel:
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, local_stats, images, labels
             )
-            # THE data-parallel step: mean grads across ranks. XLA overlaps
-            # this with the rest of backprop (DDP's bucketing, compiled).
-            grads = lax.pmean(grads, axis)
             if zero:
-                # ZeRO-1: update only this rank's dim-0 block of each
-                # eligible leaf (opt state arrived pre-sharded via in_specs),
-                # then all-gather the updated blocks. Elementwise optimizers
-                # make the math identical to the replicated update.
+                # ZeRO-1: reduce-SCATTER each eligible gradient (every rank
+                # receives only its dim-0 block of the mean — the collective
+                # the ZeRO paper prescribes, ~half an all-reduce's volume),
+                # update that block against the pre-sharded optimizer state
+                # from in_specs, and all-gather the updated blocks.
+                # Elementwise optimizers make the math identical to the
+                # replicated update.
                 idx = lax.axis_index(axis)
                 sharded = jax.tree.map(dim0_sharded, state.params)
 
@@ -228,7 +228,12 @@ class DataParallel:
                     lambda p, s: blk(p) if s else p, state.params, sharded
                 )
                 grads_blk = jax.tree.map(
-                    lambda g, s: blk(g) if s else g, grads, sharded
+                    lambda g, s: (
+                        lax.psum_scatter(g, axis, scatter_dimension=0,
+                                         tiled=True) / size
+                        if s else lax.pmean(g, axis)
+                    ),
+                    grads, sharded,
                 )
                 updates, new_opt = tx.update(
                     grads_blk, state.opt_state, params_blk
@@ -241,6 +246,10 @@ class DataParallel:
                     new_blk, sharded,
                 )
             else:
+                # THE data-parallel step: mean grads across ranks. XLA
+                # overlaps this with the rest of backprop (DDP's bucketing,
+                # compiled).
+                grads = lax.pmean(grads, axis)
                 updates, new_opt = tx.update(
                     grads, state.opt_state, state.params
                 )
