@@ -79,11 +79,34 @@ def train(args):
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
         dtype=dtype, remat=args.remat,
-        remat_policy=getattr(args, "remat_policy", "full"),
+        remat_policy=args.remat_policy,
         n_experts=(n if args.parallelism == "ep" else 0),
         router_top_k=args.router_top_k,
     )
-    tx = optax.adam(args.lr)
+    # schedule + clipping: the standard LM training kit. Cosine decay
+    # warms up linearly for --warmup steps then decays to 10% of --lr over
+    # the run; --clip-norm prepends global-norm clipping.
+    if args.schedule == "cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, args.lr, warmup_steps=max(args.warmup, 1),
+            decay_steps=max(args.steps, args.warmup + 1),
+            end_value=args.lr * 0.1,
+        )
+    else:
+        lr = args.lr
+    tx = optax.adam(lr)
+    if args.clip_norm:
+        if args.parallelism in ("pp", "3d"):
+            # inside the pipeline's shard_map the 'stages' grads are
+            # rank-local, so clip_by_global_norm would compute a DIFFERENT
+            # norm per pipe rank and scale the replicated embed/head grads
+            # inconsistently — silent divergence. Refuse until the engine
+            # clips with a psum'd global norm.
+            raise SystemExit(
+                "--clip-norm is not supported with --parallelism pp/3d "
+                "(per-stage norms would diverge); clip under dp/tp/sp/ep"
+            )
+        tx = optax.chain(optax.clip_by_global_norm(args.clip_norm), tx)
     rng = jax.random.key(0)
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
 
@@ -172,7 +195,9 @@ def train(args):
     bootstrap.cleanup()
 
 
-def main():
+def build_parser() -> argparse.ArgumentParser:
+    """Single source of the CLI; tests parse_args([]) for complete
+    defaulted Namespaces instead of hand-building partial ones."""
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--parallelism",
                         choices=["dp", "tp", "sp", "pp", "ep", "3d"],
@@ -190,6 +215,12 @@ def main():
     parser.add_argument("--n-layers", type=int, default=2)
     parser.add_argument("--d-ff", type=int, default=128)
     parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--schedule", choices=["const", "cosine"],
+                        default="const")
+    parser.add_argument("--warmup", type=int, default=0,
+                        help="linear warmup steps (cosine schedule)")
+    parser.add_argument("--clip-norm", type=float, default=0.0,
+                        help="global-norm gradient clipping (0 = off)")
     parser.add_argument("--microbatches", type=int, default=2,
                         help="pp only: GPipe microbatches per step")
     parser.add_argument("--circular-chunks", type=int, default=1,
@@ -213,8 +244,11 @@ def main():
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint each block (memory for FLOPs)")
     parser.add_argument("--force-cpu", action="store_true")
-    args = parser.parse_args()
-    train(args)
+    return parser
+
+
+def main():
+    train(build_parser().parse_args())
 
 
 if __name__ == "__main__":

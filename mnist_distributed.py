@@ -48,7 +48,8 @@ def make_optimizer(args):
     import optax
 
     if args.opt == "sgd":
-        if args.zero:
+        if args.zero and not getattr(args, "worker", False):
+            # once from the launcher; spawned --multiprocess workers skip it
             print("note: --zero with plain SGD shards no optimizer state "
                   "(SGD is stateless); use --opt momentum|adamw for the "
                   "memory win")
@@ -61,7 +62,6 @@ def make_optimizer(args):
 def train(args, world_size):
     import jax
     import jax.numpy as jnp
-    import optax
 
     from tpu_sandbox.data import ShardedBatchLoader
     from tpu_sandbox.models import pick_convnet
@@ -152,7 +152,6 @@ def train_multiprocess_worker(args, world_size):
     )
 
     import jax.numpy as jnp
-    import optax
 
     from tpu_sandbox.data import BatchLoader
     from tpu_sandbox.data.sampler import DistributedSampler

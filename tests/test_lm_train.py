@@ -5,7 +5,6 @@ mesh (tiny configs — the script itself raises SystemExit if the loss does
 not decrease, so convergence is part of the contract under test).
 """
 
-import argparse
 import sys
 from pathlib import Path
 
@@ -17,6 +16,9 @@ import lm_train  # noqa: E402
 
 
 def _args(**over):
+    """Complete args from the real parser (new flags inherit CLI defaults),
+    with the small-shape test base applied on top."""
+    args = lm_train.build_parser().parse_args([])
     base = dict(
         parallelism="dp", devices=4, steps=24, batch=4, seq_len=32, vocab=16,
         d_model=16, n_heads=2, n_layers=2, d_ff=32, lr=1e-2, microbatches=2,
@@ -24,7 +26,9 @@ def _args(**over):
         force_cpu=False, dp=1, circular_chunks=1, router_top_k=1,
     )
     base.update(over)
-    return argparse.Namespace(**base)
+    for k, v in base.items():
+        setattr(args, k, v)
+    return args
 
 
 @pytest.mark.parametrize("parallelism", ["dp", "tp", "sp", "ep"])
