@@ -87,14 +87,23 @@ def train(args):
     # warms up linearly for --warmup steps then decays to 10% of --lr over
     # the run; --clip-norm prepends global-norm clipping.
     if args.schedule == "cosine":
-        lr = optax.warmup_cosine_decay_schedule(
-            0.0, args.lr, warmup_steps=max(args.warmup, 1),
-            decay_steps=max(args.steps, args.warmup + 1),
-            end_value=args.lr * 0.1,
-        )
+        if args.warmup > 0:
+            lr = optax.warmup_cosine_decay_schedule(
+                0.0, args.lr, warmup_steps=args.warmup,
+                decay_steps=max(args.steps, args.warmup + 1),
+                end_value=args.lr * 0.1,
+            )
+        else:  # no warmup: start at peak (a forced 1-step warmup would
+            # make the first update run at lr == 0)
+            lr = optax.cosine_decay_schedule(
+                args.lr, decay_steps=max(args.steps, 1), alpha=0.1
+            )
     else:
         lr = args.lr
     tx = optax.adam(lr)
+    if args.clip_norm < 0:
+        raise SystemExit(f"--clip-norm must be >= 0, got {args.clip_norm} "
+                         "(negative max_norm would sign-flip every update)")
     if args.clip_norm:
         if args.parallelism in ("pp", "3d"):
             # inside the pipeline's shard_map the 'stages' grads are

@@ -41,6 +41,11 @@ def load_training_arrays(args, world_size):
     return images, labels
 
 
+def _zero_sgd_note():
+    print("note: --zero with plain SGD shards no optimizer state "
+          "(SGD is stateless); use --opt momentum|adamw for the memory win")
+
+
 def make_optimizer(args):
     """--opt picks the optimizer; the reference schedule is plain SGD(1e-4)
     (mnist_distributed.py:65 in the reference), kept as the default for log
@@ -49,10 +54,7 @@ def make_optimizer(args):
 
     if args.opt == "sgd":
         if args.zero and not getattr(args, "worker", False):
-            # once from the launcher; spawned --multiprocess workers skip it
-            print("note: --zero with plain SGD shards no optimizer state "
-                  "(SGD is stateless); use --opt momentum|adamw for the "
-                  "memory win")
+            _zero_sgd_note()
         return optax.sgd(learning_rate=1e-4)
     if args.opt == "momentum":
         return optax.sgd(learning_rate=1e-4, momentum=0.9)
@@ -213,6 +215,9 @@ def spawn_multiprocess(args, world_size):
     import time
 
     from tpu_sandbox.runtime.bootstrap import find_free_port
+
+    if args.zero and args.opt == "sgd":
+        _zero_sgd_note()  # workers suppress it; say it once from here
 
     if args.ckpt_dir or args.resume:
         # orbax multi-controller checkpointing needs coordinated commits;

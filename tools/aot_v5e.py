@@ -1,0 +1,150 @@
+"""Chipless v5e AOT analysis: compile the real train step for TPU without
+a TPU and read XLA's own numbers.
+
+The axon tunnel can be down for hours; this tool keeps the optimization
+loop running anyway. jax.experimental.topologies + the local libtpu build
+a compile-only v5e device (`chips_per_host_bounds=[1,1,1]` unlocks the
+1x1x1 topology), and `jit(...).lower().compile()` then yields:
+
+- ``memory_analysis()``: argument/output/temp bytes — peak-HBM estimates
+  (the chipless twin of the capacity experiment);
+- ``cost_analysis()``: executed FLOPs and bytes accessed — the traffic
+  model that predicts step time on the 819 GB/s HBM.
+
+Usage:
+  python tools/aot_v5e.py --plan s2d --batch 5            # one config
+  python tools/aot_v5e.py --plan plain --batch 5
+  python tools/aot_v5e.py --capacity --plan s2d           # bisect max batch
+
+Numbers printed here are COMPILER estimates, labeled as such — the bench
+still owns the measured truth once the chip answers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
+HBM_BYTES = 16 * 1024**3  # v5e: 16 GiB HBM per chip
+
+
+def make_topology():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.experimental import topologies
+
+    return topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:1x1x1",
+        chips_per_host_bounds=[1, 1, 1],
+    )
+
+
+def compile_step(topo, plan: str, batch: int, image_size: int = 3000,
+                 dtype_name: str = "bf16"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_sandbox.models import pick_convnet
+    from tpu_sandbox.train import TrainState, make_train_step
+
+    mesh = Mesh(np.array(topo.devices), ("data",))
+    sh = NamedSharding(mesh, P())
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    model = pick_convnet(image_size, plan=plan, dtype=dtype)
+    tx = optax.sgd(1e-4)
+    state = jax.eval_shape(lambda: TrainState.create(
+        model, jax.random.key(0),
+        jnp.zeros((1, image_size, image_size, 1), dtype), tx,
+    ))
+    state = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh), state
+    )
+    imgs = jax.ShapeDtypeStruct((batch, 28, 28, 1), jnp.float32, sharding=sh)
+    labs = jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=sh)
+    step = make_train_step(model, tx, image_size=(image_size, image_size),
+                           donate=True)
+    return step.trace(state, imgs, labs).lower().compile()
+
+
+def analyze(compiled, plan: str, batch: int) -> dict:
+    ma = compiled.memory_analysis()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    # donated args alias outputs; live peak ~ args + temps
+    peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+    return {
+        "plan": plan,
+        "batch": batch,
+        "flops": ca["flops"],
+        "bytes_accessed": ca.get("bytes accessed"),
+        "argument_bytes": ma.argument_size_in_bytes,
+        "temp_bytes": ma.temp_size_in_bytes,
+        "est_peak_bytes": peak,
+        "est_peak_gb": round(peak / 1024**3, 2),
+        "fits_16g_hbm": peak < HBM_BYTES * 0.98,
+        "est_step_ms_bw_bound": (
+            round(ca["bytes accessed"] / 819e9 * 1e3, 1)
+            if ca.get("bytes accessed") else None
+        ),
+        "source": "chipless v5e AOT compile (XLA estimates, not measurements)",
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--plan", choices=["s2d", "plain"], default="s2d")
+    p.add_argument("--batch", type=int, default=5)
+    p.add_argument("--image-size", type=int, default=3000)
+    p.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    p.add_argument("--capacity", action="store_true",
+                   help="bisect the largest batch whose est peak fits HBM")
+    args = p.parse_args()
+    topo = make_topology()
+
+    if not args.capacity:
+        compiled = compile_step(topo, args.plan, args.batch, args.image_size,
+                                args.dtype)
+        print(json.dumps(analyze(compiled, args.plan, args.batch)))
+        return
+
+    def fits(bs: int) -> bool:
+        try:
+            c = compile_step(topo, args.plan, bs, args.image_size, args.dtype)
+        except Exception as e:  # compiler OOM = does not fit
+            if "exceed" in str(e).lower() or "memory" in str(e).lower():
+                return False
+            raise
+        r = analyze(c, args.plan, bs)
+        print(json.dumps(r), flush=True)
+        return r["fits_16g_hbm"]
+
+    lo, hi, bs = 0, None, 1
+    while bs <= 512:
+        if fits(bs):
+            lo = bs
+            bs *= 2
+        else:
+            hi = bs
+            break
+    if hi is None:
+        hi = 513
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        lo, hi = (mid, hi) if fits(mid) else (lo, mid)
+    print(json.dumps({
+        "metric": "aot_est_max_batch", "plan": args.plan, "value": lo,
+        "first_over": hi if hi <= 512 else None,
+        "source": "chipless v5e AOT compile (XLA estimates)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -93,12 +93,21 @@ def block_max_pool(y: jnp.ndarray, blk: int, co: int) -> jnp.ndarray:
     """2x2/2 max-pool done inside the channel dim: y [..., blk*blk*co]
     with ordering (a*blk+b)*co+c. Pool pairs are the LOW bits of (a, b):
     original row = blk*i + a, so rows (2u, 2u+1) pair within a block.
-    Returns [..., (blk//2)**2 * co] ordered (a1*(blk//2)+b1)*co+c."""
+    Returns [..., (blk//2)**2 * co] ordered (a1*(blk//2)+b1)*co+c.
+
+    Written as elementwise maxima of four strided channel slices rather
+    than jnp.max over reshaped axes: the reduce form made XLA:TPU pick a
+    spatial-minor layout for the 8-d intermediate and materialize
+    transposes — the slice/maximum form compiles to pure fused vector ops
+    (chipless v5e AOT: −3.1 GB peak HBM on the 3000² step)."""
     *lead, c = y.shape
     assert c == blk * blk * co, (c, blk, co)
     y = y.reshape(*lead, blk // 2, 2, blk // 2, 2, co)
-    y = jnp.max(y, axis=(-4, -2))
-    return y.reshape(*lead, (blk // 2) ** 2 * co)
+    m = jnp.maximum(
+        jnp.maximum(y[..., :, 0, :, 0, :], y[..., :, 0, :, 1, :]),
+        jnp.maximum(y[..., :, 1, :, 0, :], y[..., :, 1, :, 1, :]),
+    )
+    return m.reshape(*lead, (blk // 2) ** 2 * co)
 
 
 class _Conv(nn.Module):
