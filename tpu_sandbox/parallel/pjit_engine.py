@@ -85,10 +85,19 @@ def param_specs(params, rules: Sequence[Rule]):
     )
 
 
-def state_specs(state: TrainState, rules: Sequence[Rule]) -> TrainState:
+def state_specs(state: TrainState, rules: Sequence[Rule],
+                zero_axis: str | None = None,
+                zero_axis_size: int = 1) -> TrainState:
     """Specs for a full TrainState: params by rules; optimizer state mirrors
     the params specs leaf-for-leaf where shapes match (optax state pytrees
-    contain param-shaped leaves like momenta); BN stats replicated."""
+    contain param-shaped leaves like momenta); BN stats replicated.
+
+    ``zero_axis``: compiler-driven ZeRO-1 — optimizer-state leaves whose
+    params carry NO rule (i.e. would be replicated) are instead sharded on
+    dim 0 over that axis when it divides. The SPMD partitioner then derives
+    the reduce-scatter/update/all-gather choreography from the sharding
+    mismatch between gradients and moments, the pjit spelling of what
+    DataParallel(zero=True) writes out by hand with shard_map."""
     pspecs = param_specs(state.params, rules)
 
     def opt_spec(path, leaf):
@@ -98,6 +107,12 @@ def state_specs(state: TrainState, rules: Sequence[Rule]) -> TrainState:
         for pattern, spec in rules:
             if re.search(pattern, path_s):
                 return spec
+        if (
+            zero_axis is not None and hasattr(leaf, "ndim") and leaf.ndim >= 1
+            and leaf.shape[0] >= zero_axis_size
+            and leaf.shape[0] % zero_axis_size == 0
+        ):
+            return P(zero_axis)
         return P()
 
     return TrainState(
@@ -130,6 +145,7 @@ class PjitEngine:
         image_size: tuple[int, int] | None = None,
         task: str = "image",
         aux_weight: float = 0.01,
+        zero_axis: str | None = None,
         donate: bool = True,
     ):
         if task not in ("image", "lm"):
@@ -154,14 +170,29 @@ class PjitEngine:
         # one expert (VERDICT r01 weak #8). 0.01 is the Switch paper's alpha;
         # models that sow nothing are unaffected.
         self.aux_weight = aux_weight
+        if zero_axis is not None and zero_axis not in mesh.axis_names:
+            raise ValueError(
+                f"zero axis {zero_axis!r} not in mesh axes {mesh.axis_names}"
+            )
+        self.zero_axis = zero_axis
         self.donate = donate
         self._jitted: Callable | None = None
+
+    def _state_specs(self, state: TrainState) -> TrainState:
+        """Single home for spec derivation so shard_state's placement and
+        the jitted step's in/out shardings can never desynchronize."""
+        return state_specs(
+            state, self.rules, zero_axis=self.zero_axis,
+            zero_axis_size=(
+                self.mesh.shape[self.zero_axis] if self.zero_axis else 1
+            ),
+        )
 
     def _sharding(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
     def shard_state(self, state: TrainState) -> TrainState:
-        specs = state_specs(state, self.rules)
+        specs = self._state_specs(state)
         return jax.tree.map(
             lambda x, s: jax.device_put(x, self._sharding(s)), state, specs
         )
@@ -223,7 +254,7 @@ class PjitEngine:
                 loss,
             )
 
-        specs = state_specs(state, self.rules)
+        specs = self._state_specs(state)
         to_sh = lambda tree: jax.tree.map(self._sharding, tree)  # noqa: E731
         return jax.jit(
             step,
