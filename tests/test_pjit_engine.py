@@ -131,10 +131,9 @@ def test_pjit_validates_batch_axis(mesh8):
         PjitEngine(model, tx, mesh8, batch_axis="model")
 
 
-def test_zero_axis_shards_opt_state(mesh8):
-    """Compiler-driven ZeRO-1: PjitEngine(zero_axis='data') trains the same
-    losses as the replicated engine while AdamW moments of otherwise
-    replicated params live sharded on the data axis."""
+def _train_adamw(mesh8, n_steps=3, **engine_kw):
+    """Shared harness for the ZeRO/FSDP exactness tests: AdamW ConvNet,
+    3 engine steps from a fixed init; returns (final state, losses)."""
     import optax
 
     from tpu_sandbox.data import synthetic_mnist
@@ -148,27 +147,53 @@ def test_zero_axis_shards_opt_state(mesh8):
     )
     images, labels = synthetic_mnist(n=16, seed=0)
     images, labels = normalize(images), labels.astype("int32")
+    eng = PjitEngine(model, tx, mesh8, donate=False, **engine_kw)
+    st = eng.shard_state(state0)
+    losses = []
+    for _ in range(n_steps):
+        st, loss = eng.train_step(st, *eng.shard_batch(images, labels))
+        losses.append(float(loss))
+    return st, losses
 
-    def run(zero_axis):
-        eng = PjitEngine(model, tx, mesh8, zero_axis=zero_axis, donate=False)
-        st = eng.shard_state(state0)
-        losses = []
-        for _ in range(3):
-            st, loss = eng.train_step(st, *eng.shard_batch(images, labels))
-            losses.append(float(loss))
-        return st, losses
 
-    st_rep, losses_rep = run(None)
-    st_zero, losses_zero = run("data")
+def _assert_params_equal(a, b):
+    for (kp, x), (_, y) in zip(
+        jax.tree_util.tree_leaves_with_path(a),
+        jax.tree_util.tree_leaves_with_path(b),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x), atol=1e-6,
+            err_msg=jax.tree_util.keystr(kp),
+        )
+
+
+def test_zero_axis_shards_opt_state(mesh8):
+    """Compiler-driven ZeRO-1: PjitEngine(zero_axis='data') trains the same
+    losses as the replicated engine while AdamW moments of otherwise
+    replicated params live sharded on the data axis."""
+    st_rep, losses_rep = _train_adamw(mesh8)
+    st_zero, losses_zero = _train_adamw(mesh8, zero_axis="data")
     np.testing.assert_allclose(losses_zero, losses_rep, rtol=1e-5)
     mu = st_zero.opt_state[0].mu
     fc_spec = mu["fc"]["kernel"].sharding.spec
     assert fc_spec and fc_spec[0] == "data", fc_spec
-    for (kp, a), (_, b) in zip(
-        jax.tree_util.tree_leaves_with_path(st_rep.params),
-        jax.tree_util.tree_leaves_with_path(st_zero.params),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(b), np.asarray(a), atol=1e-6,
-            err_msg=jax.tree_util.keystr(kp),
-        )
+    conv_spec = mu["conv1"]["kernel"].sharding.spec
+    assert not conv_spec or conv_spec[0] is None, conv_spec
+    _assert_params_equal(st_rep.params, st_zero.params)
+
+
+def test_fsdp_axis_shards_params(mesh8):
+    """FSDP (ZeRO-3) as specs: params themselves live sharded on the data
+    axis, GSPMD all-gathers at use; training matches the replicated engine
+    and both params and AdamW moments carry the dim-0 'data' sharding."""
+    st_rep, losses_rep = _train_adamw(mesh8)
+    st_fsdp, losses_fsdp = _train_adamw(mesh8, fsdp_axis="data")
+    np.testing.assert_allclose(losses_fsdp, losses_rep, rtol=1e-5)
+    fc = st_fsdp.params["fc"]["kernel"]
+    assert fc.sharding.spec and fc.sharding.spec[0] == "data", fc.sharding
+    mu = st_fsdp.opt_state[0].mu["fc"]["kernel"]
+    assert mu.sharding.spec and mu.sharding.spec[0] == "data", mu.sharding
+    # conv kernels (dim0=5, not divisible by 8) stay replicated
+    ck = st_fsdp.params["conv1"]["kernel"].sharding.spec
+    assert not ck or ck[0] is None, ck
+    _assert_params_equal(st_rep.params, st_fsdp.params)

@@ -78,16 +78,40 @@ def _path_str(path) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
-def param_specs(params, rules: Sequence[Rule]):
-    """Map a params pytree to PartitionSpecs via path-regex rules."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, _: spec_for_path(_path_str(path), rules), params
-    )
+def param_specs(params, rules: Sequence[Rule],
+                fsdp_axis: str | None = None, fsdp_axis_size: int = 1):
+    """Map a params pytree to PartitionSpecs via path-regex rules.
+
+    ``fsdp_axis``: fully-sharded data parallelism (ZeRO-3) as pure specs —
+    leaves no rule claims are sharded on dim 0 over that axis when it
+    divides; GSPMD then all-gathers each parameter just-in-time at its use
+    and reduce-scatters its gradient, deriving the FSDP choreography from
+    the sharding alone."""
+
+    def spec(path, leaf):
+        path_s = _path_str(path)
+        # explicit rules win outright — including an explicit P() pin; FSDP
+        # only claims leaves NO rule matched
+        for pattern, s in rules:
+            if re.search(pattern, path_s):
+                return s
+        if (
+            fsdp_axis is not None
+            and hasattr(leaf, "ndim") and leaf.ndim >= 1
+            and leaf.shape[0] >= fsdp_axis_size
+            and leaf.shape[0] % fsdp_axis_size == 0
+        ):
+            return P(fsdp_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
 
 
 def state_specs(state: TrainState, rules: Sequence[Rule],
                 zero_axis: str | None = None,
-                zero_axis_size: int = 1) -> TrainState:
+                zero_axis_size: int = 1,
+                fsdp_axis: str | None = None,
+                fsdp_axis_size: int = 1) -> TrainState:
     """Specs for a full TrainState: params by rules; optimizer state mirrors
     the params specs leaf-for-leaf where shapes match (optax state pytrees
     contain param-shaped leaves like momenta); BN stats replicated.
@@ -98,7 +122,8 @@ def state_specs(state: TrainState, rules: Sequence[Rule],
     the reduce-scatter/update/all-gather choreography from the sharding
     mismatch between gradients and moments, the pjit spelling of what
     DataParallel(zero=True) writes out by hand with shard_map."""
-    pspecs = param_specs(state.params, rules)
+    pspecs = param_specs(state.params, rules, fsdp_axis=fsdp_axis,
+                         fsdp_axis_size=fsdp_axis_size)
 
     def opt_spec(path, leaf):
         # param-shaped moment buffers share the param's spec; scalars/counters
@@ -146,6 +171,7 @@ class PjitEngine:
         task: str = "image",
         aux_weight: float = 0.01,
         zero_axis: str | None = None,
+        fsdp_axis: str | None = None,
         donate: bool = True,
     ):
         if task not in ("image", "lm"):
@@ -170,11 +196,20 @@ class PjitEngine:
         # one expert (VERDICT r01 weak #8). 0.01 is the Switch paper's alpha;
         # models that sow nothing are unaffected.
         self.aux_weight = aux_weight
+        if fsdp_axis is not None:
+            if fsdp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"fsdp axis {fsdp_axis!r} not in mesh axes "
+                    f"{mesh.axis_names}"
+                )
+            # FSDP subsumes ZeRO-1: moments follow their (sharded) params
+            zero_axis = zero_axis or fsdp_axis
         if zero_axis is not None and zero_axis not in mesh.axis_names:
             raise ValueError(
                 f"zero axis {zero_axis!r} not in mesh axes {mesh.axis_names}"
             )
         self.zero_axis = zero_axis
+        self.fsdp_axis = fsdp_axis
         self.donate = donate
         self._jitted: Callable | None = None
 
@@ -185,6 +220,10 @@ class PjitEngine:
             state, self.rules, zero_axis=self.zero_axis,
             zero_axis_size=(
                 self.mesh.shape[self.zero_axis] if self.zero_axis else 1
+            ),
+            fsdp_axis=self.fsdp_axis,
+            fsdp_axis_size=(
+                self.mesh.shape[self.fsdp_axis] if self.fsdp_axis else 1
             ),
         )
 
