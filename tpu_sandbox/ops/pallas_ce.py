@@ -54,29 +54,49 @@ def pallas_cross_entropy(
     return _forward(logits, labels, interpret)
 
 
+def _block_rows(cp: int) -> int | None:
+    """Rows per grid step, sized so the kernel's [rows, cp] f32 view stays
+    within scoped VMEM (~4 MB budget of the 16 MB/core): at a 32k vocab
+    that is 32 rows, small vocabs keep the full 128. Caught by a chipless
+    v5e AOT compile — the fixed 128-row block OOMed VMEM at [16384, 32768].
+    Returns None when even 8 rows exceed the budget (vocab > 128k) — the
+    caller then falls back to the jnp loss, which is numerically the same."""
+    budget = 4 * 1024 * 1024
+    rows = (budget // (cp * 4) // 8) * 8
+    return min(_BLOCK_N, rows) if rows >= 8 else None
+
+
 def _forward(logits, labels, interpret):
     n, c = logits.shape
     interpret = default_interpret(interpret)
-    np_, cp = _round_up(n, _BLOCK_N), _round_up(c, _LANE)
+    cp = _round_up(c, _LANE)
+    block_n = _block_rows(cp)
+    if block_n is None:  # vocab too wide for one VMEM row-block
+        from tpu_sandbox.ops.losses import cross_entropy_loss
+
+        return cross_entropy_loss(logits, labels)
+    np_ = _round_up(n, block_n)
+    # pad in the INPUT dtype — the f32 promotion happens inside the kernel
+    # per block, so no [N, C] f32 copy ever lands in HBM
     logits_p = jnp.pad(
-        logits.astype(jnp.float32), ((0, np_ - n), (0, cp - c)),
-        constant_values=_NEG,
+        logits, ((0, np_ - n), (0, cp - c)),
+        constant_values=jnp.asarray(_NEG, logits.dtype),
     )
     # padded rows: give them label 0 and a 0-logit at class 0 so their loss
     # is finite garbage; they are sliced off below
     logits_p = logits_p.at[n:, 0].set(0.0)
     labels_p = jnp.pad(labels.astype(jnp.int32), (0, np_ - n))[:, None]
 
-    grid = (np_ // _BLOCK_N,)
+    grid = (np_ // block_n,)
     per_row = pl.pallas_call(
         _ce_kernel,
         out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_N, cp), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_N, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, cp), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((_BLOCK_N, 1), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
         interpret=interpret,
     )(logits_p, labels_p)
     return jnp.mean(per_row[:n, 0])

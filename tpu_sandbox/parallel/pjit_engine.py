@@ -65,13 +65,19 @@ def megatron_rules(model_axis: str = "model") -> list[Rule]:
     ]
 
 
-def spec_for_path(path: str, rules: Sequence[Rule]) -> P:
+def _match_rule(path: str, rules: Sequence[Rule]) -> P | None:
     """First rule whose regex matches the '/'-joined param path wins;
-    default replicated."""
+    None when no rule claims the path (callers decide the fallback)."""
     for pattern, spec in rules:
         if re.search(pattern, path):
             return spec
-    return P()
+    return None
+
+
+def spec_for_path(path: str, rules: Sequence[Rule]) -> P:
+    """Rule-matched spec for a path, default replicated."""
+    s = _match_rule(path, rules)
+    return P() if s is None else s
 
 
 def _path_str(path) -> str:
@@ -89,12 +95,11 @@ def param_specs(params, rules: Sequence[Rule],
     the sharding alone."""
 
     def spec(path, leaf):
-        path_s = _path_str(path)
         # explicit rules win outright — including an explicit P() pin; FSDP
         # only claims leaves NO rule matched
-        for pattern, s in rules:
-            if re.search(pattern, path_s):
-                return s
+        s = _match_rule(_path_str(path), rules)
+        if s is not None:
+            return s
         if (
             fsdp_axis is not None
             and hasattr(leaf, "ndim") and leaf.ndim >= 1
@@ -128,10 +133,9 @@ def state_specs(state: TrainState, rules: Sequence[Rule],
     def opt_spec(path, leaf):
         # param-shaped moment buffers share the param's spec; scalars/counters
         # are replicated. Match by trailing path against the params tree.
-        path_s = _path_str(path)
-        for pattern, spec in rules:
-            if re.search(pattern, path_s):
-                return spec
+        s = _match_rule(_path_str(path), rules)
+        if s is not None:
+            return s
         if (
             zero_axis is not None and hasattr(leaf, "ndim") and leaf.ndim >= 1
             and leaf.shape[0] >= zero_axis_size
@@ -202,8 +206,14 @@ class PjitEngine:
                     f"fsdp axis {fsdp_axis!r} not in mesh axes "
                     f"{mesh.axis_names}"
                 )
+            if zero_axis is not None and zero_axis != fsdp_axis:
+                raise ValueError(
+                    f"zero_axis {zero_axis!r} conflicts with fsdp_axis "
+                    f"{fsdp_axis!r}: moments must shard with their params "
+                    "(omit zero_axis — FSDP subsumes ZeRO-1)"
+                )
             # FSDP subsumes ZeRO-1: moments follow their (sharded) params
-            zero_axis = zero_axis or fsdp_axis
+            zero_axis = fsdp_axis
         if zero_axis is not None and zero_axis not in mesh.axis_names:
             raise ValueError(
                 f"zero axis {zero_axis!r} not in mesh axes {mesh.axis_names}"
