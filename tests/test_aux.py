@@ -123,3 +123,25 @@ def test_bench_is_oom_matcher():
                          "would exceed memory (size=17179869184)")
     assert bench._is_oom("oom while allocating")
     assert not bench._is_oom("ValueError: shapes do not match")
+
+
+def test_pallas_ce_huge_vocab_falls_back_to_jnp():
+    """Beyond ~128k vocab no row block fits the VMEM budget; the call must
+    fall back to the jnp loss with identical value and gradient."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+    from tpu_sandbox.ops.pallas_ce import _block_rows, pallas_cross_entropy
+
+    assert _block_rows(512 * 1024) is None
+    assert _block_rows(32768) == 32
+    assert _block_rows(1024) == 128
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 200000)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 200000, size=(8,)), jnp.int32)
+    v, g = jax.value_and_grad(pallas_cross_entropy)(logits, labels)
+    v_ref, g_ref = jax.value_and_grad(cross_entropy_loss)(logits, labels)
+    np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-7)

@@ -84,3 +84,21 @@ def test_pallas_ce_lowers_for_tpu():
         lambda lg, lb: pallas_cross_entropy(lg, lb, interpret=False),
         logits, labels,
     )
+
+
+@pytest.mark.parametrize("n,c", [(256, 32768), (64, 128 * 1024)])
+def test_pallas_ce_reduced_blocks_lower_for_tpu(n, c):
+    """The VMEM-budgeted row blocks (32 rows at 32k vocab, the 8-row floor
+    at 128k) must still lower under Mosaic — the fixed 128-row block OOMed
+    scoped VMEM at LM scale (found by a chipless v5e AOT compile)."""
+    from tpu_sandbox.ops.pallas_ce import _block_rows
+    from tpu_sandbox.ops.pallas_common import round_up
+
+    assert _block_rows(round_up(c, 128)) is not None
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(n, c)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, c, size=(n,)), jnp.int32)
+    _lower_tpu(
+        lambda lg, lb: pallas_cross_entropy(lg, lb, interpret=False),
+        logits, labels,
+    )

@@ -127,6 +127,11 @@ def state_specs(state: TrainState, rules: Sequence[Rule],
     the reduce-scatter/update/all-gather choreography from the sharding
     mismatch between gradients and moments, the pjit spelling of what
     DataParallel(zero=True) writes out by hand with shard_map."""
+    if fsdp_axis is not None and zero_axis is None:
+        # FSDP subsumes ZeRO-1 at THIS layer too (not just in the engine
+        # constructor): params sharded without their moments would quietly
+        # keep 2x replicated optimizer memory per device
+        zero_axis, zero_axis_size = fsdp_axis, fsdp_axis_size
     pspecs = param_specs(state.params, rules, fsdp_axis=fsdp_axis,
                          fsdp_axis_size=fsdp_axis_size)
 
