@@ -213,12 +213,13 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
     if quick:
         image_size, configs = 128, [("fp32", 2), ("fp32", 4)]
     else:
-        # ladder chosen around the chipless AOT capacity estimate for the
-        # s2d plan (~16 at 3000² bf16, measured/aot_capacity_s2d.jsonl):
-        # dense near the expected best point, plus one past-capacity row so
-        # the OOM boundary lands in the table
-        configs = [("bf16", 5), ("bf16", 8), ("bf16", 10), ("bf16", 13),
-                   ("bf16", 16), ("bf16", 20), ("fp32", 5)]
+        # ladder chosen around the chipless AOT capacity estimates for the
+        # s2d plan with the fused tail (bs=16 fits at ~15.3 GB peak, bs=17+
+        # OOMs; measured/aot_capacity_s2d_fused.jsonl): dense near the
+        # expected best point, plus one past-capacity row so the OOM
+        # boundary lands in the table
+        configs = [("bf16", 5), ("bf16", 8), ("bf16", 12), ("bf16", 16),
+                   ("bf16", 20), ("fp32", 5)]
     rows, best = [], None
     for dtype_name, bs in configs:
         try:

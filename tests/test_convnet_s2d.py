@@ -155,10 +155,12 @@ def test_short_training_runs_stay_together():
     np.testing.assert_allclose(run(s2d), run(ref), rtol=1e-4)
 
 
-def test_s2d_under_data_parallel_matches_plain_model(mesh8):
+@pytest.mark.parametrize("fused_tail", [False, True])
+def test_s2d_under_data_parallel_matches_plain_model(mesh8, fused_tail):
     """The headline-bench path: ConvNetS2D inside DataParallel over 8
     shards trains the same losses as ConvNet in the same engine (shared
-    init; BN per-replica in both)."""
+    init; BN per-replica in both) — with and without the fused Pallas
+    tail, since pick_convnet defaults production entry points to fused."""
     from tpu_sandbox.data import synthetic_mnist
     from tpu_sandbox.data.mnist import normalize
     from tpu_sandbox.parallel import DataParallel
@@ -167,7 +169,8 @@ def test_s2d_under_data_parallel_matches_plain_model(mesh8):
     images, labels = synthetic_mnist(n=16, seed=0)
     images, labels = normalize(images), labels.astype("int32")
     tx = optax.sgd(1e-2)
-    ref, s2d = _models()
+    ref, _ = _models()
+    s2d = ConvNetS2D(fused_tail=fused_tail)
     variables = ref.init(jax.random.key(0),
                          jnp.zeros((1, 32, 32, 1), jnp.float32))
     state0 = TrainState(
@@ -188,3 +191,43 @@ def test_s2d_under_data_parallel_matches_plain_model(mesh8):
     np.testing.assert_allclose(
         np.stack(run(s2d)), np.stack(run(ref)), rtol=2e-4, atol=2e-4
     )
+
+
+def test_fused_tail_matches_unfused_model():
+    """ConvNetS2D(fused_tail=True) == ConvNetS2D: logits, grads, and BN
+    running stats over a short training run with shared init."""
+    x, y = _data(n=2, hw=32, seed=5)
+    plain = ConvNetS2D()
+    fused = ConvNetS2D(fused_tail=True)
+    variables = plain.init(jax.random.key(0), x)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def step(model, params, stats):
+        def f(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits, y), upd
+        (loss, upd), g = jax.value_and_grad(f, has_aux=True)(params)
+        return loss, g, upd["batch_stats"]
+
+    lp, gp, sp = step(plain, params, stats)
+    lf, gf, sf = step(fused, params, stats)
+    np.testing.assert_allclose(float(lf), float(lp), atol=1e-5)
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(gp),
+        jax.tree_util.tree_leaves_with_path(gf),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4,
+            err_msg=jax.tree_util.keystr(kp),
+        )
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(sp),
+        jax.tree_util.tree_leaves_with_path(sf),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-5,
+            err_msg=jax.tree_util.keystr(kp),
+        )

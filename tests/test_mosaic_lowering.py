@@ -102,3 +102,23 @@ def test_pallas_ce_reduced_blocks_lower_for_tpu(n, c):
         lambda lg, lb: pallas_cross_entropy(lg, lb, interpret=False),
         logits, labels,
     )
+
+
+@pytest.mark.parametrize("blk,co,w", [(4, 16, 752), (2, 32, 752)])
+def test_fused_bn_tail_lowers_for_tpu(blk, co, w):
+    """The fused BN-apply+relu+pool kernels (ops/pallas_bn_tail.py) at the
+    s2d ConvNet's real lane widths (C=256 and C=128) — forward and both
+    backward kernels."""
+    from tpu_sandbox.ops.pallas_bn_tail import fused_bn_relu_pool
+
+    rng = np.random.default_rng(4)
+    c = blk * blk * co
+    y = jnp.asarray(rng.standard_normal((2, 10, w, c)), jnp.bfloat16)
+    gamma = jnp.ones(co, jnp.float32)
+    beta = jnp.zeros(co, jnp.float32)
+
+    def loss(y, gamma, beta):
+        out, _, _ = fused_bn_relu_pool(y, gamma, beta, co, blk, 1e-5, False)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, gamma, beta)

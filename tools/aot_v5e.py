@@ -27,6 +27,9 @@ import json
 import os
 
 os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+# lower the REAL Mosaic kernels, not the interpreter (see pallas_common):
+# this process only compiles, never executes
+os.environ.setdefault("TPU_SANDBOX_FORCE_COMPILED_KERNELS", "1")
 
 HBM_BYTES = 16 * 1024**3  # v5e: 16 GiB HBM per chip
 

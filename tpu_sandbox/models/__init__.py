@@ -5,10 +5,24 @@ from tpu_sandbox.models.convnet_s2d import ConvNetS2D  # noqa: F401
 def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     """The execution-plan switch: ConvNetS2D (space-to-depth, the TPU fast
     path — see models/convnet_s2d.py) when the plan applies, else the plain
-    ConvNet. Both are the same function (tests/test_convnet_s2d.py)."""
+    ConvNet. Both are the same function (tests/test_convnet_s2d.py).
+
+    On backends where Pallas kernels COMPILE (TPU, or chipless AOT with
+    TPU_SANDBOX_FORCE_COMPILED_KERNELS=1) the s2d plan defaults to the
+    fused Pallas BN/ReLU/pool tail (2.6x less HBM traffic per image by v5e
+    AOT analysis of the compiled Mosaic kernels: 5.45 vs 14.18 GB/img at
+    bs=16; equality-tested). Elsewhere the kernels would run interpreted —
+    a large slowdown in a training loop — so the default stays unfused.
+    Pass fused_tail explicitly to override either way (accepted and
+    ignored by the plain plan)."""
     h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
-    if plan == "plain":
-        return ConvNet(**kwargs)
-    if plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0):
-        return ConvNetS2D(**kwargs)
+    fused = kwargs.pop("fused_tail", None)
+    if plan != "plain" and (
+        plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0)
+    ):
+        if fused is None:
+            from tpu_sandbox.ops.pallas_common import default_interpret
+
+            fused = not default_interpret(None)
+        return ConvNetS2D(fused_tail=fused, **kwargs)
     return ConvNet(**kwargs)

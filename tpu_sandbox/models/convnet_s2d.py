@@ -139,42 +139,65 @@ class _GroupedBN(nn.Module):
     """flax nn.BatchNorm semantics (f32 fast variance clipped at 0, biased
     running var, momentum blend, (x-mean)*rsqrt(var+eps)*scale+bias) over
     grouped channels [..., g*co] with (co,)-shaped stats — numerically the
-    plain BN over the un-s2d tensor, and the same variable names/shapes."""
+    plain BN over the un-s2d tensor, and the same variable names/shapes.
+
+    ``fused(y, blk)`` runs the whole BN-apply+relu+pool tail as the Pallas
+    kernel pair instead (ops/pallas_bn_tail.py) — same variables, same
+    math, one HBM pass per direction."""
 
     features: int  # co
     dtype: jnp.dtype
     momentum: float = 0.9
     epsilon: float = 1e-5
 
-    @nn.compact
+    def setup(self):
+        co = self.features
+        self.scale = self.param(
+            "scale", nn.initializers.ones, (co,), jnp.float32
+        )
+        self.offset = self.param(
+            "bias", nn.initializers.zeros, (co,), jnp.float32
+        )
+        self.ra_mean = self.variable(
+            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), (co,)
+        )
+        self.ra_var = self.variable(
+            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), (co,)
+        )
+
+    def _update_running(self, mu, var):
+        if not self.is_initializing():
+            m = self.momentum
+            self.ra_mean.value = m * self.ra_mean.value + (1 - m) * mu
+            self.ra_var.value = m * self.ra_var.value + (1 - m) * var
+
     def __call__(self, y, train: bool):
         co = self.features
         *lead, c = y.shape
         yg = y.reshape(*lead, c // co, co)
-        ra_mean = self.variable(
-            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), (co,)
-        )
-        ra_var = self.variable(
-            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), (co,)
-        )
-        scale = self.param("scale", nn.initializers.ones, (co,), jnp.float32)
-        bias = self.param("bias", nn.initializers.zeros, (co,), jnp.float32)
         if train:
             yf = yg.astype(jnp.float32)
             red = tuple(range(yf.ndim - 1))
             mu = jnp.mean(yf, axis=red)
             mu2 = jnp.mean(jnp.square(yf), axis=red)
             var = jnp.maximum(0.0, mu2 - jnp.square(mu))
-            if not self.is_initializing():
-                m = self.momentum
-                ra_mean.value = m * ra_mean.value + (1 - m) * mu
-                ra_var.value = m * ra_var.value + (1 - m) * var
+            self._update_running(mu, var)
         else:
-            mu, var = ra_mean.value, ra_var.value
+            mu, var = self.ra_mean.value, self.ra_var.value
         out = (yg.astype(jnp.float32) - mu) * (
-            jax.lax.rsqrt(var + self.epsilon) * scale
-        ) + bias
+            jax.lax.rsqrt(var + self.epsilon) * self.scale
+        ) + self.offset
         return out.astype(self.dtype).reshape(*lead, c)
+
+    def fused(self, y, blk: int):
+        from tpu_sandbox.ops.pallas_bn_tail import fused_bn_relu_pool
+
+        out, mu, var = fused_bn_relu_pool(
+            y, self.scale, self.offset, self.features, blk, self.epsilon,
+            None,
+        )
+        self._update_running(mu, var)
+        return out
 
 
 class ConvNetS2D(nn.Module):
@@ -182,12 +205,19 @@ class ConvNetS2D(nn.Module):
 
     Requires H, W divisible by 4 (the reference's 3000x3000 qualifies) and
     a single input channel. Other configs: use models.convnet.ConvNet.
+
+    ``fused_tail=True`` runs each BN-apply + ReLU + pool tail as the fused
+    Pallas kernel pair (ops/pallas_bn_tail.py — one HBM pass per direction
+    instead of several) in train mode; eval and use_bn=False keep the
+    plain ops. Same math either way (tests/test_pallas_bn_tail.py), and
+    the variable tree is identical, so checkpoints interoperate.
     """
 
     num_classes: int = 10
     features: tuple[int, ...] = (16, 32)
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
     use_bn: bool = True
+    fused_tail: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -202,17 +232,20 @@ class ConvNetS2D(nn.Module):
 
         x = space_to_depth(x, 4).astype(self.dtype)      # [N,H/4,W/4,16]
         y = _Conv((5, 5, 1, f1), r=4, dtype=self.dtype, name="conv1")(x)
-        if self.use_bn:
-            y = _GroupedBN(f1, self.dtype, name="bn1")(y, train)
-        y = nn.relu(y)
-        y = block_max_pool(y, 4, f1)                      # [N,H/4,W/4,4*f1]
+        y = self._tail(y, f1, 4, "bn1", train)            # [N,H/4,W/4,4*f1]
 
         y = _Conv((5, 5, f1, f2), r=2, dtype=self.dtype, name="conv2")(y)
-        if self.use_bn:
-            y = _GroupedBN(f2, self.dtype, name="bn2")(y, train)
-        y = nn.relu(y)
-        y = block_max_pool(y, 2, f2)                      # [N,H/4,W/4,f2]
+        y = self._tail(y, f2, 2, "bn2", train)            # [N,H/4,W/4,f2]
 
         y = y.reshape(n, -1)
         y = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(y)
         return jnp.asarray(y, jnp.float32)
+
+    def _tail(self, y, co: int, blk: int, name: str, train: bool):
+        """BN + ReLU + 2x2 block pool — fused Pallas pair when enabled."""
+        if self.use_bn and self.fused_tail and train:
+            return _GroupedBN(co, self.dtype, name=name).fused(y, blk)
+        if self.use_bn:
+            y = _GroupedBN(co, self.dtype, name=name)(y, train)
+        y = nn.relu(y)
+        return block_max_pool(y, blk, co)

@@ -9,6 +9,8 @@ compiled on TPU and interpreted in CPU tests.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 NEG = -1e30
@@ -20,7 +22,16 @@ def round_up(x: int, m: int) -> int:
 
 
 def default_interpret(interpret: bool | None) -> bool:
-    """Kernels compile only on TPU; anywhere else, interpret."""
+    """Kernels compile only on TPU; anywhere else, interpret.
+
+    TPU_SANDBOX_FORCE_COMPILED_KERNELS=1 overrides the backend check for
+    chipless AOT analysis (tools/aot_v5e.py): there the default backend is
+    CPU but lowering targets a TPU topology, and interpret-mode kernels
+    would make the compiler's memory/traffic numbers describe the
+    interpreter's loop, not the Mosaic kernel. Compile-only — executing on
+    CPU with this set would fail."""
     if interpret is None:
+        if os.environ.get("TPU_SANDBOX_FORCE_COMPILED_KERNELS") == "1":
+            return False
         return jax.default_backend() != "tpu"
     return interpret
