@@ -642,6 +642,28 @@ def bench_pallas(force_cpu: bool) -> dict:
     assert ce_err < 1e-3, ce_err
     checks["ce_64x32000"] = ce_err
 
+    # the s2d ConvNet's fused BN/ReLU/pool tail vs the unfused jnp chain
+    from tpu_sandbox.ops.pallas_bn_tail import (
+        fused_bn_relu_pool,
+        unfused_reference,
+    )
+
+    co, blk = (16, 4) if on_tpu else (4, 2)
+    hw = 40 if on_tpu else 8
+    c = blk * blk * co
+    yb = jnp.asarray(rng.normal(size=(2, hw, hw, c)), jnp.bfloat16)
+    gam = jnp.asarray(1 + 0.1 * rng.normal(size=co), jnp.float32)
+    bet = jnp.asarray(rng.normal(size=co), jnp.float32)
+    fout, fmu, fvar = fused_bn_relu_pool(yb, gam, bet, co, blk, 1e-5,
+                                         interpret)
+    tail_ref, mu_r, var_r = unfused_reference(yb, gam, bet, co, blk)
+    assert float(jnp.max(jnp.abs(fmu - mu_r))) < 1e-4
+    assert float(jnp.max(jnp.abs(fvar - var_r))) < 1e-4
+    tail_err = float(jnp.max(jnp.abs(fout.astype(jnp.float32)
+                                     - tail_ref.astype(jnp.float32))))
+    assert tail_err < 2e-2, tail_err
+    checks[f"bn_tail_blk{blk}_co{co}"] = tail_err
+
     # Micro-throughput of the flash kernel at a real shape (honest timing).
     # Interpret mode runs the kernel body per grid cell in Python — the
     # s=4096 shape would take hours on CPU, so the fallback shrinks it

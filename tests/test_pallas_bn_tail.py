@@ -9,22 +9,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpu_sandbox.models.convnet_s2d import block_max_pool
-from tpu_sandbox.ops.pallas_bn_tail import fused_bn_relu_pool
-
-
-def ref_chain(y, gamma, beta, co, blk, eps=1e-5):
-    """The unfused tail exactly as ConvNetS2D computes it in train mode."""
-    *lead, c = y.shape
-    g = c // co
-    yf = y.astype(jnp.float32).reshape(*lead, g, co)
-    red = tuple(range(yf.ndim - 1))
-    mu = jnp.mean(yf, axis=red)
-    var = jnp.maximum(0.0, jnp.mean(jnp.square(yf), axis=red)
-                      - jnp.square(mu))
-    z = (yf - mu) * (jax.lax.rsqrt(var + eps) * gamma) + beta
-    z = jax.nn.relu(z.reshape(*lead, c).astype(y.dtype))
-    return block_max_pool(z, blk, co), mu, var
+from tpu_sandbox.ops.pallas_bn_tail import (
+    fused_bn_relu_pool,
+    unfused_reference as ref_chain,
+)
 
 
 @pytest.mark.parametrize("blk,co,hw", [(4, 4, 12), (2, 16, 8), (4, 16, 8)])

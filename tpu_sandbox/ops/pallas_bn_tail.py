@@ -186,6 +186,23 @@ def _grid_rows(h: int, w: int, c: int) -> int:
     return 1
 
 
+def unfused_reference(y, gamma, beta, co: int, blk: int, eps: float = 1e-5):
+    """The unfused tail exactly as ConvNetS2D computes it in train mode:
+    (pooled, mu, var). Single home for the contract the kernels are checked
+    against (tests/test_pallas_bn_tail.py and bench.py --metric pallas)."""
+    from tpu_sandbox.models.convnet_s2d import block_max_pool
+
+    *lead, c = y.shape
+    yf = y.astype(jnp.float32).reshape(*lead, c // co, co)
+    red = tuple(range(yf.ndim - 1))
+    mu = jnp.mean(yf, axis=red)
+    var = jnp.maximum(0.0, jnp.mean(jnp.square(yf), axis=red)
+                      - jnp.square(mu))
+    z = (yf - mu) * (jax.lax.rsqrt(var + eps) * gamma) + beta
+    z = jax.nn.relu(z.reshape(*lead, c).astype(y.dtype))
+    return block_max_pool(z, blk, co), mu, var
+
+
 def _stats(y, co):
     yf = y.astype(jnp.float32).reshape(-1, y.shape[-1] // co, co)
     mu = jnp.mean(yf, axis=(0, 1))
