@@ -156,7 +156,8 @@ def train(args):
             raise SystemExit(f"pp needs n_layers divisible by {n} devices")
         mesh = make_mesh({"data": 1, "pipe": n}, devices=devices)
         eng = PipelineParallel(cfg, tx, mesh, microbatches=args.microbatches,
-                               circular_chunks=args.circular_chunks)
+                               circular_chunks=args.circular_chunks,
+                               attention_fn=attention_fn)
         state = eng.init_state(rng, sample)
     elif p == "3d":
         # data x model x pipe: DP batch sharding, Megatron TP inside each
@@ -173,6 +174,7 @@ def train(args):
         eng = PipelineParallel(
             cfg, tx, mesh, microbatches=args.microbatches,
             model_axis="model", circular_chunks=args.circular_chunks,
+            attention_fn=attention_fn,
         )
         state = eng.init_state(rng, sample)
     elif p == "ep":

@@ -104,6 +104,46 @@ def test_pallas_ce_reduced_blocks_lower_for_tpu(n, c):
     )
 
 
+def test_pipeline_flash_stage_lowers_for_tpu():
+    """The flash kernel reached through PipelineParallel's stage compute —
+    jax.checkpoint(lax.scan over stacked per-layer params) around the
+    Pallas call, fwd AND bwd (VERDICT r02 weak #4 done-criterion). Scoped
+    to the stage computation: under shard_map JAX dispatches pallas_call
+    lowering on the ACTUAL backend, so the full shard_map'd step cannot be
+    cross-lowered for TPU from CPU ("Only interpret mode is supported on
+    CPU backend"); the collectives around the stage are kernel-free and
+    covered by the interpret-mode execution tests above this one."""
+    import optax
+
+    from tpu_sandbox.models.transformer import TransformerConfig
+    from tpu_sandbox.ops.pallas_attention import flash_attention_fn
+    from tpu_sandbox.parallel.pipeline import PipelineParallel
+    from tpu_sandbox.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=4,
+                            d_ff=64, max_len=256, dtype=jnp.bfloat16)
+    mesh = make_mesh({"data": 2, "pipe": 4})
+    pp = PipelineParallel(cfg, optax.sgd(0.1), mesh, microbatches=2,
+                          donate=False,
+                          attention_fn=flash_attention_fn(interpret=False))
+    # init eagerly EXECUTES the model on CPU, where interpret=False would
+    # fail — init through the dense twin instead (params are
+    # attention_fn-independent, same tree either way)
+    pp_dense = PipelineParallel(cfg, optax.sgd(0.1), mesh, microbatches=2,
+                                donate=False)
+    tokens = np.zeros((4, 256), np.int32)
+    state = pp_dense.init_state(jax.random.key(0), jnp.asarray(tokens))
+    # one stage's layer stack, as the tick loop slices it: [v, lps, ...] -> c=0
+    stage = jax.tree.map(lambda x: x[0, 0], state.params["stages"])
+    h = jnp.zeros((2, 256, cfg.d_model), cfg.dtype)
+
+    def stage_loss(stage, h):
+        out = jax.checkpoint(pp._stage_apply)(stage, h)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _lower_tpu(jax.grad(stage_loss, argnums=(0, 1)), stage, h)
+
+
 @pytest.mark.parametrize("blk,co,w", [(4, 16, 752), (2, 32, 752)])
 def test_fused_bn_tail_lowers_for_tpu(blk, co, w):
     """The fused BN-apply+relu+pool kernels (ops/pallas_bn_tail.py) at the

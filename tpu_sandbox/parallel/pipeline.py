@@ -188,6 +188,7 @@ class PipelineParallel:
         circular_chunks: int = 1,
         remat: bool = True,
         donate: bool = True,
+        attention_fn: Callable | None = None,
     ):
         axes = (data_axis, pipe_axis) + ((model_axis,) if model_axis else ())
         for ax in axes:
@@ -222,8 +223,14 @@ class PipelineParallel:
                     f"{config.n_heads} and d_ff={config.d_ff} must divide by "
                     f"{model_axis}={m}"
                 )
-        self.block = Block(config)
-        self.model = TransformerLM(config)  # init / parity twin
+        # attention_fn is injected through to every stage block (and the
+        # init/parity twin) exactly as models.transformer.TransformerLM:89
+        # accepts it — flash (O(S) memory) instead of the dense [S,S]
+        # causal_attention at the sequence lengths the SP schemes target.
+        # Params are attention_fn-independent, so checkpoints interchange.
+        self.attention_fn = attention_fn
+        self.block = Block(config, attention_fn)
+        self.model = TransformerLM(config, attention_fn)  # init / parity twin
         self._build(donate)
 
     def bubble_fraction(self) -> float:
@@ -352,7 +359,11 @@ class PipelineParallel:
             + a["qkv"]["bias"].astype(dt)
         )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = causal_attention(q, k, v)  # local heads only
+        # heads are already local shards (H/m); any [B,S,H,D] attention_fn
+        # works per-head unchanged — flash here keeps TP stages O(S) memory
+        # instead of causal_attention's dense [S,S] score materialization
+        attn_fn = self.attention_fn or causal_attention
+        attn = attn_fn(q, k, v)  # local heads only
         partial = jnp.einsum(
             "bshk,hkd->bsd", attn, a["out"]["kernel"].astype(dt)
         )
