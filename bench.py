@@ -677,6 +677,46 @@ def bench_pallas(force_cpu: bool) -> dict:
     assert tail_err < 2e-2, tail_err
     checks[f"bn_tail_blk{blk}_co{co}"] = tail_err
 
+    # the s2d conv kernels (fwd + stats variant + full VJP) vs lax.conv —
+    # fused_conv is the pick_convnet TPU default, so an on-chip run of the
+    # headline path depends on these compiling AND agreeing numerically
+    from tpu_sandbox.ops.pallas_conv import (
+        conv3x3,
+        conv3x3_reference,
+        conv3x3_stats,
+    )
+
+    ch, cco, chw = (16, 256, 40) if on_tpu else (4, 8, 10)
+    xc = jnp.asarray(rng.normal(size=(2, chw, chw, ch)), jnp.bfloat16)
+    kc = jnp.asarray(0.1 * rng.normal(size=(3, 3, ch, cco)), jnp.bfloat16)
+    bc = jnp.asarray(rng.normal(size=(cco,)), jnp.bfloat16)
+    yc, sc, ssc = conv3x3_stats(xc, kc, bc, interpret)
+    yc_ref = conv3x3_reference(xc, kc, bc)
+    conv_err = float(jnp.max(jnp.abs(yc.astype(jnp.float32)
+                                     - yc_ref.astype(jnp.float32))))
+    assert conv_err < 0.15, conv_err  # bf16 conv, K up to 9*16 taps
+    yf = yc.astype(jnp.float32).reshape(-1, cco)
+    assert float(jnp.max(jnp.abs(sc[0] - yf.sum(0)))
+                 / max(1.0, float(jnp.max(jnp.abs(sc))))) < 1e-3
+    checks[f"conv3x3_{ch}to{cco}"] = conv_err
+    gc = jax.grad(
+        lambda x, k, b: jnp.sum(conv3x3(x, k, b, interpret)
+                                .astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2),
+    )(xc, kc, bc)
+    gr = jax.grad(
+        lambda x, k, b: jnp.sum(conv3x3_reference(
+            x.astype(jnp.float32), k.astype(jnp.float32),
+            b.astype(jnp.float32)) ** 2),
+        argnums=(0, 1, 2),
+    )(xc, kc, bc)
+    for a, r, nm in zip(gc, gr, ("dx", "dw", "db")):
+        scale = max(1.0, float(jnp.max(jnp.abs(r))))
+        rel = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - r.astype(jnp.float32)))) / scale
+        assert rel < 0.05, (nm, rel)
+        checks[f"conv3x3_grad_{nm}"] = rel
+
     # Micro-throughput of the flash kernel at a real shape (honest timing).
     # Interpret mode runs the kernel body per grid cell in Python — the
     # s=4096 shape would take hours on CPU, so the fallback shrinks it

@@ -155,12 +155,17 @@ def test_short_training_runs_stay_together():
     np.testing.assert_allclose(run(s2d), run(ref), rtol=1e-4)
 
 
-@pytest.mark.parametrize("fused_tail", [False, True])
-def test_s2d_under_data_parallel_matches_plain_model(mesh8, fused_tail):
+@pytest.mark.parametrize(
+    "fused_tail,fused_conv",
+    [(False, False), (True, False), (True, True), (False, True)],
+)
+def test_s2d_under_data_parallel_matches_plain_model(mesh8, fused_tail,
+                                                     fused_conv):
     """The headline-bench path: ConvNetS2D inside DataParallel over 8
     shards trains the same losses as ConvNet in the same engine (shared
     init; BN per-replica in both) — with and without the fused Pallas
-    tail, since pick_convnet defaults production entry points to fused."""
+    tail/conv, since pick_convnet defaults production entry points to
+    both fused."""
     from tpu_sandbox.data import synthetic_mnist
     from tpu_sandbox.data.mnist import normalize
     from tpu_sandbox.parallel import DataParallel
@@ -170,7 +175,7 @@ def test_s2d_under_data_parallel_matches_plain_model(mesh8, fused_tail):
     images, labels = normalize(images), labels.astype("int32")
     tx = optax.sgd(1e-2)
     ref, _ = _models()
-    s2d = ConvNetS2D(fused_tail=fused_tail)
+    s2d = ConvNetS2D(fused_tail=fused_tail, fused_conv=fused_conv)
     variables = ref.init(jax.random.key(0),
                          jnp.zeros((1, 32, 32, 1), jnp.float32))
     state0 = TrainState(
@@ -193,12 +198,13 @@ def test_s2d_under_data_parallel_matches_plain_model(mesh8, fused_tail):
     )
 
 
-def test_fused_tail_matches_unfused_model():
-    """ConvNetS2D(fused_tail=True) == ConvNetS2D: logits, grads, and BN
-    running stats over a short training run with shared init."""
+@pytest.mark.parametrize("fused_conv", [False, True])
+def test_fused_tail_matches_unfused_model(fused_conv):
+    """ConvNetS2D(fused_tail=True[, fused_conv=True]) == ConvNetS2D:
+    logits, grads, and BN running stats with shared init."""
     x, y = _data(n=2, hw=32, seed=5)
     plain = ConvNetS2D()
-    fused = ConvNetS2D(fused_tail=True)
+    fused = ConvNetS2D(fused_tail=True, fused_conv=fused_conv)
     variables = plain.init(jax.random.key(0), x)
     params, stats = variables["params"], variables["batch_stats"]
 

@@ -144,6 +144,35 @@ def test_pipeline_flash_stage_lowers_for_tpu():
     _lower_tpu(jax.grad(stage_loss, argnums=(0, 1)), stage, h)
 
 
+@pytest.mark.parametrize("c,co", [(16, 256), (64, 128)])
+def test_pallas_conv_lowers_for_tpu(c, co):
+    """The 3x3 s2d conv kernels (ops/pallas_conv.py) at the ConvNet's real
+    per-layer widths (conv1: 16->256, conv2: 64->128, W=750), fwd + the
+    full VJP (flipped-weight dgrad + fused wgrad/dbias) — manual-DMA halo
+    strips and scratch accumulators must pass real Mosaic checks."""
+    from tpu_sandbox.ops.pallas_conv import conv3x3
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((1, 20, 750, c)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((3, 3, c, co)), jnp.bfloat16)
+    b = jnp.zeros((co,), jnp.bfloat16)
+
+    def loss(x, k, b):
+        return jnp.sum(conv3x3(x, k, b, False).astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), x, k, b)
+
+    # the TPU-default train path runs the STATS variant (scratch
+    # accumulators, pl.when init/emit, [1,co] stats outputs) — lower it too
+    from tpu_sandbox.ops.pallas_conv import conv3x3_stats
+
+    def loss_stats(x, k, b):
+        y, s, ss = conv3x3_stats(x, k, b, False)
+        return jnp.sum(y.astype(jnp.float32)) + jnp.sum(s) + jnp.sum(ss)
+
+    _lower_tpu(jax.grad(loss_stats, argnums=(0, 1, 2)), x, k, b)
+
+
 @pytest.mark.parametrize("blk,co,w", [(4, 16, 752), (2, 32, 752)])
 def test_fused_bn_tail_lowers_for_tpu(blk, co, w):
     """The fused BN-apply+relu+pool kernels (ops/pallas_bn_tail.py) at the

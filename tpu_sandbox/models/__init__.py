@@ -17,12 +17,15 @@ def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     ignored by the plain plan)."""
     h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
     fused = kwargs.pop("fused_tail", None)
+    fused_conv = kwargs.pop("fused_conv", None)
     if plan != "plain" and (
         plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0)
     ):
-        if fused is None:
+        if fused is None or fused_conv is None:
             from tpu_sandbox.ops.pallas_common import default_interpret
 
-            fused = not default_interpret(None)
-        return ConvNetS2D(fused_tail=fused, **kwargs)
+            compiled = not default_interpret(None)
+            fused = compiled if fused is None else fused
+            fused_conv = compiled if fused_conv is None else fused_conv
+        return ConvNetS2D(fused_tail=fused, fused_conv=fused_conv, **kwargs)
     return ConvNet(**kwargs)

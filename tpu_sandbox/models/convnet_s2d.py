@@ -112,27 +112,45 @@ def block_max_pool(y: jnp.ndarray, blk: int, co: int) -> jnp.ndarray:
 
 class _Conv(nn.Module):
     """Holds a canonical [5,5,ci,co] kernel + bias (same names, shapes,
-    inits as the nn.Conv in ConvNet) and applies it s2d-scattered."""
+    inits as the nn.Conv in ConvNet) and applies it s2d-scattered.
+
+    ``fused=True`` runs the scattered 3x3 conv as the Pallas kernel
+    (ops/pallas_conv.py — one HBM pass per direction, no packed-form
+    copies) instead of lax.conv; same math (tests/test_pallas_conv.py),
+    f32 accumulation either way on TPU, identical variables."""
 
     shape: tuple[int, ...]
     r: int
     dtype: jnp.dtype
+    fused: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, want_stats: bool = False):
+        """Returns y — or (y, (sum, sumsq)) when ``want_stats`` (fused
+        path only): the BN batch-statistics reductions computed inside the
+        conv kernel's output pass (ops/pallas_conv.py::conv3x3_stats)."""
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
         )
         bias = self.param(
             "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
         )
+        wg = scatter_kernel(kernel.astype(self.dtype), self.r)
+        reps = wg.shape[-1] // self.shape[-1]
+        bias_g = jnp.tile(bias.astype(self.dtype), reps)
+        if self.fused:
+            from tpu_sandbox.ops.pallas_conv import conv3x3, conv3x3_stats
+
+            if want_stats:
+                y, s, ss = conv3x3_stats(x, wg, bias_g)
+                return y, (s, ss)
+            return conv3x3(x, wg, bias_g)
+        assert not want_stats, "stats fusion requires the fused conv"
         y = jax.lax.conv_general_dilated(
-            x, scatter_kernel(kernel.astype(self.dtype), self.r),
-            window_strides=(1, 1), padding="SAME",
+            x, wg, window_strides=(1, 1), padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        reps = y.shape[-1] // self.shape[-1]
-        return y + jnp.tile(bias.astype(self.dtype), reps)
+        return y + bias_g
 
 
 class _GroupedBN(nn.Module):
@@ -189,12 +207,12 @@ class _GroupedBN(nn.Module):
         ) + self.offset
         return out.astype(self.dtype).reshape(*lead, c)
 
-    def fused(self, y, blk: int):
+    def fused(self, y, blk: int, ysums=None):
         from tpu_sandbox.ops.pallas_bn_tail import fused_bn_relu_pool
 
         out, mu, var = fused_bn_relu_pool(
             y, self.scale, self.offset, self.features, blk, self.epsilon,
-            None,
+            None, ysums,
         )
         self._update_running(mu, var)
         return out
@@ -218,6 +236,9 @@ class ConvNetS2D(nn.Module):
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
     use_bn: bool = True
     fused_tail: bool = False
+    # run the scattered 3x3 convs as Pallas kernels (ops/pallas_conv.py):
+    # kills XLA's packed-form conv copies — same gating as fused_tail
+    fused_conv: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -230,21 +251,31 @@ class ConvNetS2D(nn.Module):
         n, h, w = x.shape
         assert h % 4 == 0 and w % 4 == 0, (h, w)
 
-        x = space_to_depth(x, 4).astype(self.dtype)      # [N,H/4,W/4,16]
-        y = _Conv((5, 5, 1, f1), r=4, dtype=self.dtype, name="conv1")(x)
-        y = self._tail(y, f1, 4, "bn1", train)            # [N,H/4,W/4,4*f1]
+        # stats ride along inside the conv kernels when the whole fused
+        # chain is active (train mode: eval BN uses running stats)
+        fuse_stats = self.fused_conv and self.fused_tail and self.use_bn \
+            and train
 
-        y = _Conv((5, 5, f1, f2), r=2, dtype=self.dtype, name="conv2")(y)
-        y = self._tail(y, f2, 2, "bn2", train)            # [N,H/4,W/4,f2]
+        x = space_to_depth(x, 4).astype(self.dtype)      # [N,H/4,W/4,16]
+        y = _Conv((5, 5, 1, f1), r=4, dtype=self.dtype,
+                  fused=self.fused_conv, name="conv1")(x, fuse_stats)
+        y, ysums = y if fuse_stats else (y, None)
+        y = self._tail(y, f1, 4, "bn1", train, ysums)     # [N,H/4,W/4,4*f1]
+
+        y = _Conv((5, 5, f1, f2), r=2, dtype=self.dtype,
+                  fused=self.fused_conv, name="conv2")(y, fuse_stats)
+        y, ysums = y if fuse_stats else (y, None)
+        y = self._tail(y, f2, 2, "bn2", train, ysums)     # [N,H/4,W/4,f2]
 
         y = y.reshape(n, -1)
         y = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(y)
         return jnp.asarray(y, jnp.float32)
 
-    def _tail(self, y, co: int, blk: int, name: str, train: bool):
+    def _tail(self, y, co: int, blk: int, name: str, train: bool,
+              ysums=None):
         """BN + ReLU + 2x2 block pool — fused Pallas pair when enabled."""
         if self.use_bn and self.fused_tail and train:
-            return _GroupedBN(co, self.dtype, name=name).fused(y, blk)
+            return _GroupedBN(co, self.dtype, name=name).fused(y, blk, ysums)
         if self.use_bn:
             y = _GroupedBN(co, self.dtype, name=name)(y, train)
         y = nn.relu(y)

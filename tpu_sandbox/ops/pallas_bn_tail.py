@@ -213,22 +213,37 @@ def _stats(y, co):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def fused_bn_relu_pool(y, gamma, beta, co, blk, eps=1e-5, interpret=None):
+def fused_bn_relu_pool(y, gamma, beta, co, blk, eps=1e-5, interpret=None,
+                       ysums=None):
     """[N,H,W,blk*blk*co] conv output -> ([N,H,W,(blk//2)**2*co] pooled,
     mu [co], var [co]) with train-mode batch statistics.
 
     Numerically the _GroupedBN(train=True) + relu + block_max_pool chain of
     models/convnet_s2d.py, in one HBM pass. mu/var are returned for the
     running-stats update; their cotangents are ignored (the stats update is
-    not differentiated — flax BatchNorm behaves the same)."""
-    out, mu, var, _ = _forward(y, gamma, beta, co, blk, eps, interpret)
+    not differentiated — flax BatchNorm behaves the same).
+
+    ``ysums=(sum, sumsq)`` — per-LANE [1, c] f32 reductions of y, e.g. from
+    ops/pallas_conv.py::conv3x3_stats — skips this function's own stats
+    pass (a full extra HBM read of y). Their cotangents are zero by the
+    same contract as mu/var: the train-mode backward here already routes
+    the statistics' dependence on y through dy."""
+    out, mu, var, _ = _forward(y, gamma, beta, co, blk, eps, interpret,
+                               ysums)
     return out, mu, var
 
 
-def _forward(y, gamma, beta, co, blk, eps, interpret):
+def _forward(y, gamma, beta, co, blk, eps, interpret, ysums=None):
     n, h, w, c = y.shape
     assert c == blk * blk * co, (c, blk, co)
-    mu, var = _stats(y, co)
+    if ysums is None:
+        mu, var = _stats(y, co)
+    else:
+        s_co = ysums[0][0].astype(jnp.float32).reshape(-1, co).sum(0)
+        ss_co = ysums[1][0].astype(jnp.float32).reshape(-1, co).sum(0)
+        count = y.size // co
+        mu = s_co / count
+        var = jnp.maximum(0.0, ss_co / count - jnp.square(mu))
     inv = jax.lax.rsqrt(var + eps)
     a_co = inv * gamma.astype(jnp.float32)
     a_lane = _lane_expand(a_co, blk * blk)
@@ -252,18 +267,18 @@ def _forward(y, gamma, beta, co, blk, eps, interpret):
     return out, mu, var, (a_lane, b_lane, inv)
 
 
-def _vjp_fwd(y, gamma, beta, co, blk, eps, interpret):
+def _vjp_fwd(y, gamma, beta, co, blk, eps, interpret, ysums=None):
     out, mu, var, (a_lane, b_lane, inv) = _forward(
-        y, gamma, beta, co, blk, eps, interpret
+        y, gamma, beta, co, blk, eps, interpret, ysums
     )
-    return (out, mu, var), (y, gamma, mu, inv, a_lane, b_lane)
+    return (out, mu, var), (y, gamma, mu, inv, a_lane, b_lane, ysums)
 
 
 def _vjp_bwd(co, blk, eps, interpret, res, cts):
     from jax.experimental.pallas import tpu as pltpu
 
     g = cts[0]  # stats cotangents (cts[1:]) ignored — see docstring
-    y, gamma, mu, inv, a_lane, b_lane = res
+    y, gamma, mu, inv, a_lane, b_lane, ysums = res
     n, h, w, c = y.shape
     hb = _grid_rows(h, w, c)
     interp = default_interpret(interpret)
@@ -323,7 +338,8 @@ def _vjp_bwd(co, blk, eps, interpret, res, cts):
         interpret=interp,
     )(y, a_lane, b_lane, g, sel_t, mu_lane, inv_lane, gi_lane, c1_lane,
       c2_lane)
-    return dy, s2_co.astype(gamma.dtype), s1_co.astype(gamma.dtype)
+    dsums = jax.tree.map(jnp.zeros_like, ysums)  # see docstring; None -> None
+    return dy, s2_co.astype(gamma.dtype), s1_co.astype(gamma.dtype), dsums
 
 
 fused_bn_relu_pool.defvjp(_vjp_fwd, _vjp_bwd)
