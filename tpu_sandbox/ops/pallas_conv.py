@@ -53,12 +53,17 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_sandbox.ops.pallas_common import default_interpret
 
 
-def _pick_block_h(h: int) -> int:
-    """Rows per grid block: VMEM holds ~ bh·W·C input + bh·W·CO output
-    (+ a [W, CO] f32 accumulator); 10 rows is comfortable at the ConvNet's
-    750x750 shapes and divides 750. Falls back to any divisor."""
+def _pick_block_h(h: int, w: int, c: int, co: int) -> int:
+    """Rows per grid block, budgeted against scoped VMEM (16 MB): the
+    pipeline double-buffers the bh·W·(C + CO) in/out blocks and the row
+    loop keeps ~a [W, 9C] bf16 tap tile plus [W, CO] f32 accumulators
+    live. bh=10 at conv1-wgrad's 750-wide 16->256 shape hit 21.9 MB on
+    the Mosaic stack (chipless AOT compile); this budget lands it at 3."""
+    per_bh = w * (c + co) * 2 * 2            # double-buffered blocks, bf16
+    per_row = w * (9 * c + co) * 4           # tap tile + f32 row buffers
+    cap = max(1, int(7_000_000 // max(per_bh + per_row, 1)))
     for bh in (10, 8, 6, 5, 4, 3, 2, 1):
-        if h % bh == 0:
+        if bh <= cap and h % bh == 0:
             return bh
     return 1
 
@@ -102,17 +107,27 @@ def _row_getter(x_ref, up_ref, dn_ref, bh: int, nblk: int):
     return get
 
 
+def _tap_tile(get, r: int):
+    """The row's im2col tile [W, 9·C], built in VMEM (lane concatenates —
+    VPU work, zero HBM cost). Tap order (dy, dx) major then C, matching
+    the [9C, CO] flattening of w. One [W, 9C] x [9C, CO] matmul then runs
+    the MXU at K = 9C (K=144 for conv1) instead of nine K=C matmuls —
+    at C=16, nine separate taps would leave 7/8 of the MXU's contraction
+    rows idle and make the kernel compute-bound."""
+    return jnp.concatenate(
+        [_shift_w(get(r + dy - 1), dx)
+         for dy in range(3) for dx in range(3)],
+        axis=1,
+    )
+
+
 def _conv_row(get, w_ref, b_ref, r: int):
-    acc = b_ref[...].astype(jnp.float32)  # [1, CO], broadcasts over W
-    for dy in range(3):
-        row = get(r + dy - 1)  # [W, C]
-        for dx in range(3):
-            acc = acc + jax.lax.dot_general(
-                _shift_w(row, dx), w_ref[dy, dx],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-    return acc
+    acc = jax.lax.dot_general(
+        _tap_tile(get, r), w_ref[...],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return acc + b_ref[...].astype(jnp.float32)  # [1, CO] broadcasts over W
 
 
 def _fwd_kernel(x_ref, up_ref, dn_ref, w_ref, b_ref, y_ref,
@@ -160,20 +175,14 @@ def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
         db_scr[:] = jnp.zeros_like(db_scr)
 
     get = _row_getter(x_ref, up_ref, dn_ref, bh, nblk)
-    c = x_ref.shape[-1]
     for r in range(bh):
         g_row = g_ref[0, r].astype(jnp.float32)  # [W, CO]
         db_scr[:] = db_scr[:] + jnp.sum(g_row, axis=0, keepdims=True)
-        for dy in range(3):
-            row = get(r + dy - 1)
-            for dx in range(3):
-                tap = jax.lax.dot_general(  # contract W: [C, CO]
-                    _shift_w(row, dx).astype(jnp.float32), g_row,
-                    (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                k = (dy * 3 + dx) * c
-                dw_scr[pl.ds(k, c)] = dw_scr[pl.ds(k, c)] + tap
+        dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
+            _tap_tile(get, r), g_row,  # contract W: [9C, CO], K=W on MXU
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(jnp.logical_and(n == pl.num_programs(0) - 1, i == nblk - 1))
     def _emit():
@@ -184,7 +193,7 @@ def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
 def _conv_call(x, w, bias, out_dtype, interpret, stats=False):
     n, h, wd, c = x.shape
     co = w.shape[-1]
-    bh = _pick_block_h(h)
+    bh = _pick_block_h(h, wd, c, co)
     nblk = h // bh
     if stats:
         kernel = functools.partial(_fwd_stats_kernel, bh=bh, nblk=nblk)
@@ -208,7 +217,7 @@ def _conv_call(x, w, bias, out_dtype, interpret, stats=False):
         out_shape=out_shape,
         grid=(n, nblk),
         in_specs=_halo_specs(bh, nblk, wd, c) + [
-            pl.BlockSpec((3, 3, c, co), lambda n, i: (0, 0, 0, 0)),
+            pl.BlockSpec((9 * c, co), lambda n, i: (0, 0)),
             pl.BlockSpec((1, co), lambda n, i: (0, 0)),
         ],
         out_specs=out_specs,
@@ -217,7 +226,7 @@ def _conv_call(x, w, bias, out_dtype, interpret, stats=False):
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=default_interpret(interpret),
-    )(x, x, x, w, bias.reshape(1, co))
+    )(x, x, x, w.reshape(9 * c, co), bias.reshape(1, co))
 
 
 def _flip_transpose(w):
@@ -247,7 +256,7 @@ def _conv_vjp_bwd(interpret, res, g):
     # pallas_call is side-effect free, so XLA DCEs it there
     dx = _conv_call(g, _flip_transpose(w), jnp.zeros((c,), g.dtype),
                     x.dtype, interpret)
-    bh = _pick_block_h(h)
+    bh = _pick_block_h(h, wd, c, co)
     nblk = h // bh
     dw_flat, db = pl.pallas_call(
         functools.partial(_wgrad_kernel, bh=bh, nblk=nblk),
