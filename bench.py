@@ -57,7 +57,7 @@ def accelerator_usable(timeout: float = 240.0) -> bool:
 
 def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
           dtype_name: str, force_cpu: bool, baseline: float,
-          plan: str = "auto") -> dict:
+          plan: str = "auto", model_overrides: dict | None = None) -> dict:
     from tpu_sandbox.utils.cli import ensure_devices
 
     import jax
@@ -80,7 +80,8 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
     from tpu_sandbox.utils.profiling import host_sync, measure_per_step
 
     dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
-    model = pick_convnet(image_size, plan=plan, dtype=dtype)
+    model = pick_convnet(image_size, plan=plan, dtype=dtype,
+                         **(model_overrides or {}))
     tx = optax.sgd(1e-4)
     global_batch = batch_per_device * n_dev
 
@@ -851,9 +852,40 @@ def main():
         result["degraded"] = ("accelerator unavailable; CPU fallback "
                               f"overrode {overridden or 'nothing'}")
     else:
-        result = bench(args.image_size, args.batch_per_device, args.steps,
-                       args.warmup, args.dtype, False, args.baseline,
-                       plan=args.plan)
+        # Fallback ladder: the production plan runs three Pallas kernel
+        # families (conv, bn-tail) proven by chipless force-compiles but —
+        # while the tunnel outage holds — never executed on this chip's
+        # runtime. A kernel-compile failure must degrade the line, not
+        # crash the bench and leave the round without an artifact.
+        ladder = [
+            ({}, None),
+            (dict(fused_conv=False), "pallas conv kernels disabled"),
+            (dict(fused_conv=False, fused_tail=False),
+             "all pallas kernels disabled"),
+        ]
+        result, last_err = None, None
+        for overrides, note in ladder:
+            try:
+                result = bench(args.image_size, args.batch_per_device,
+                               args.steps, args.warmup, args.dtype, False,
+                               args.baseline, plan=args.plan,
+                               model_overrides=overrides)
+                if note:
+                    result["plan_fallback"] = (
+                        f"{note} after: {type(last_err).__name__}: "
+                        f"{str(last_err)[:300]}"
+                    )
+                break
+            except Exception as e:  # noqa: BLE001 — artifact > purity
+                last_err = e
+        if result is None:
+            result = {
+                "metric": "train_images_per_sec_3000x3000_mnist",
+                "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+                "degraded": ("every execution plan failed; last error: "
+                             f"{type(last_err).__name__}: "
+                             f"{str(last_err)[:500]}"),
+            }
     print(json.dumps(result))
 
 

@@ -126,6 +126,20 @@ def test_stats_variant(dt):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
 
 
+def test_block_h_budget():
+    """VMEM-budget regression pin: bh=10 at conv1-wgrad's real shape
+    (W=750, 16->256) overflowed the Mosaic scoped-vmem stack (21.9 MB >
+    16 MB) in the chipless AOT compile; the budget must keep the real
+    ConvNet shapes at <= 4 rows while leaving tiny test shapes fast."""
+    from tpu_sandbox.ops.pallas_conv import _pick_block_h
+
+    assert _pick_block_h(750, 750, 16, 256) <= 4
+    assert _pick_block_h(750, 750, 64, 128) <= 4
+    assert _pick_block_h(750, 750, 128, 64) <= 4  # conv2 dgrad shape
+    assert _pick_block_h(20, 12, 16, 32) == 10   # test shapes stay fast
+    assert 750 % _pick_block_h(750, 750, 16, 256) == 0
+
+
 def test_s2d_scattered_kernel_path():
     """The exact shapes ConvNetS2D uses: conv1's s2d-scattered 3x3 kernel
     (16->256, r=4) on a miniature image, against the reference conv."""
