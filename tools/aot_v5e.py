@@ -25,6 +25,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
+
+# runnable as `python tools/aot_v5e.py` from anywhere (sys.path[0] is
+# tools/, which does not see the tpu_sandbox package at the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 # lower the REAL Mosaic kernels, not the interpreter (see pallas_common):
