@@ -330,14 +330,15 @@ def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
     images, labels = synthetic_mnist(n=max(max_batch, 10), seed=0)
     images, labels = normalize(images), labels.astype("int32")
 
-    def trial(bs: int) -> bool:
+    def trial(bs: int, remat: bool = False) -> bool:
         try:
             state = TrainState.create(
                 model, jax.random.key(0),
                 jnp.zeros((1, image_size, image_size, 1), dtype), tx,
             )
             step = make_train_step(
-                model, tx, image_size=(image_size, image_size), donate=True
+                model, tx, image_size=(image_size, image_size), donate=True,
+                remat=remat,
             )
             state, loss = step(state, jnp.asarray(images[:bs]),
                                jnp.asarray(labels[:bs]))
@@ -349,23 +350,31 @@ def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
                 return False
             raise
 
-    lo, hi = 0, None
-    bs = 1
-    while bs <= max_batch:
-        if trial(bs):
-            lo = bs
-            bs *= 2
-        else:
-            hi = bs
-            break
-    if hi is None:
-        hi = max_batch + 1  # never failed up to the cap
-    while hi - lo > 1:
-        mid = (lo + hi) // 2
-        if trial(mid):
-            lo = mid
-        else:
-            hi = mid
+    def bisect(remat: bool, start: int = 1):
+        lo, hi, bs = 0, None, start
+        while bs <= max_batch:
+            if trial(bs, remat):
+                lo = bs
+                bs *= 2
+            else:
+                hi = bs
+                break
+        if hi is None:
+            hi = max_batch + 1  # never failed up to the cap
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if trial(mid, remat):
+                lo = mid
+            else:
+                hi = mid
+        return lo, hi
+
+    lo, hi = bisect(remat=False)
+    # the capacity lever: recompute-forward backward (make_train_step
+    # remat) drops the saved conv activations from peak memory — the
+    # one-device counterpart of "just buy a second GPU". Start the
+    # doubling from the plain max (remat can only help).
+    lo_r, hi_r = bisect(remat=True, start=max(lo, 1))
 
     # the reference's workaround story, demonstrated on one chip: if the
     # effective batch 10 doesn't fit directly, 2-step gradient accumulation
@@ -397,6 +406,8 @@ def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
         "baseline_kind": "reference A5000 24GB: bs=5 runs, bs=10 OOMs "
                          "(README.md:9-15)",
         "first_oom_batch": hi if hi <= max_batch else None,
+        "max_batch_remat": lo_r,
+        "first_oom_batch_remat": hi_r if hi_r <= max_batch else None,
         "probe_cap": max_batch,
         "effective_batch_10_via_accum2": accum_ok,
         "execution_plan": type(model).__name__,

@@ -135,3 +135,32 @@ def test_periodic_checkpointing(tmp_path):
     assert ckpt.latest_step(tmp_path) == 3
     restored = ckpt.restore(tmp_path, state)
     assert int(restored.step) == 3
+
+
+def test_remat_step_matches_plain_step():
+    """make_train_step(remat=True) — the capacity lever — must be a pure
+    memory/compute trade: identical loss, updated params, and BN stats to
+    the plain step from the same state."""
+    import jax
+
+    model = ConvNet()
+    tx = optax.sgd(1e-2)
+    images, labels = synthetic_mnist(n=8, seed=3)
+    images, labels = normalize(images), labels.astype("int32")
+    state0 = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 32, 32, 1)), tx
+    )
+
+    def run(remat):
+        step = make_train_step(model, tx, image_size=(32, 32),
+                               donate=False, remat=remat)
+        return step(state0, jnp.asarray(images), jnp.asarray(labels))
+
+    (sp, lp), (sr, lr) = run(False), run(True)
+    np.testing.assert_allclose(float(lr), float(lp), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        (sr.params, sr.batch_stats), (sp.params, sp.batch_stats),
+    )

@@ -52,7 +52,7 @@ def make_topology():
 
 
 def compile_step(topo, plan: str, batch: int, image_size: int = 3000,
-                 dtype_name: str = "bf16"):
+                 dtype_name: str = "bf16", remat: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -77,11 +77,11 @@ def compile_step(topo, plan: str, batch: int, image_size: int = 3000,
     imgs = jax.ShapeDtypeStruct((batch, 28, 28, 1), jnp.float32, sharding=sh)
     labs = jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=sh)
     step = make_train_step(model, tx, image_size=(image_size, image_size),
-                           donate=True)
+                           donate=True, remat=remat)
     return step.trace(state, imgs, labs).lower().compile()
 
 
-def analyze(compiled, plan: str, batch: int) -> dict:
+def analyze(compiled, plan: str, batch: int, remat: bool = False) -> dict:
     ma = compiled.memory_analysis()
     ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):
@@ -90,6 +90,7 @@ def analyze(compiled, plan: str, batch: int) -> dict:
     peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
     return {
         "plan": plan,
+        "remat": remat,
         "batch": batch,
         "flops": ca["flops"],
         "bytes_accessed": ca.get("bytes accessed"),
@@ -112,6 +113,9 @@ def main():
     p.add_argument("--batch", type=int, default=5)
     p.add_argument("--image-size", type=int, default=3000)
     p.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute-forward backward (jax.checkpoint over "
+                        "the loss) — the capacity lever")
     p.add_argument("--capacity", action="store_true",
                    help="bisect the largest batch whose est peak fits HBM")
     args = p.parse_args()
@@ -119,18 +123,19 @@ def main():
 
     if not args.capacity:
         compiled = compile_step(topo, args.plan, args.batch, args.image_size,
-                                args.dtype)
-        print(json.dumps(analyze(compiled, args.plan, args.batch)))
+                                args.dtype, remat=args.remat)
+        print(json.dumps(analyze(compiled, args.plan, args.batch, args.remat)))
         return
 
     def fits(bs: int) -> bool:
         try:
-            c = compile_step(topo, args.plan, bs, args.image_size, args.dtype)
+            c = compile_step(topo, args.plan, bs, args.image_size, args.dtype,
+                             remat=args.remat)
         except Exception as e:  # compiler OOM = does not fit
             if "exceed" in str(e).lower() or "memory" in str(e).lower():
                 return False
             raise
-        r = analyze(c, args.plan, bs)
+        r = analyze(c, args.plan, bs, args.remat)
         print(json.dumps(r), flush=True)
         return r["fits_16g_hbm"]
 
@@ -148,7 +153,8 @@ def main():
         mid = (lo + hi) // 2
         lo, hi = (mid, hi) if fits(mid) else (lo, mid)
     print(json.dumps({
-        "metric": "aot_est_max_batch", "plan": args.plan, "value": lo,
+        "metric": "aot_est_max_batch", "plan": args.plan,
+        "remat": args.remat, "value": lo,
         "first_over": hi if hi <= 512 else None,
         "source": "chipless v5e AOT compile (XLA estimates)",
     }))

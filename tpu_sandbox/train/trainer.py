@@ -55,6 +55,7 @@ def make_train_step(
     image_size: tuple[int, int] | None = None,
     accum_steps: int = 1,
     donate: bool = True,
+    remat: bool = False,
 ) -> Callable:
     """Build the jit'd (state, images, labels) -> (state, loss) step.
 
@@ -86,6 +87,15 @@ def make_train_step(
         )
         return cross_entropy_loss(logits, labels), mutated.get("batch_stats", {})
 
+    # ``remat``: recompute the whole forward during backward instead of
+    # saving activations (jax.checkpoint over the loss). The capacity
+    # lever for the reference's OOM experiment — on the 3000² ConvNet the
+    # dominant saved residual is conv1's [N,750,750,256] output (~300 MB/
+    # image); remat trades it for one extra forward pass of (cheap, at
+    # these MFUs) FLOPs. BN batch-stats semantics are unchanged: the aux
+    # stats output is part of the checkpointed function.
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
