@@ -21,6 +21,11 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
+import sys
+
+# runnable as `python tools/convnet_breakdown.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -152,6 +157,37 @@ def main():
             lambda xx: nhwc(xx, w1s).astype(f32).sum())(x), x1s),
         "conv2_s2d_grad": (lambda x: jax.grad(
             lambda xx: nhwc(xx, w2s).astype(f32).sum())(x), x2s),
+    })
+
+    # the r03 production kernels (ops/pallas_conv.py, ops/pallas_bn_tail.py):
+    # per-stage times for the exact ops the fused plan runs, fwd and VJP —
+    # measured against the XLA rows above, these attribute any gap between
+    # the AOT traffic/compute floors and the whole-step headline
+    from tpu_sandbox.ops.pallas_bn_tail import fused_bn_relu_pool
+    from tpu_sandbox.ops.pallas_conv import conv3x3, conv3x3_stats
+
+    b1s = arr(256, dtype=bf16)
+    b2s = arr(128, dtype=bf16)
+    gam1 = jnp.ones(16, f32)
+    bet1 = jnp.zeros(16, f32)
+    y1s = arr(b, hw // 4, hw // 4, 256)
+
+    stages.update({
+        "conv1_pallas": (lambda x: conv3x3(x, w1s.astype(bf16), b1s), x1s),
+        "conv1_pallas_stats": (
+            lambda x: conv3x3_stats(x, w1s.astype(bf16), b1s)[0], x1s),
+        "conv2_pallas": (lambda x: conv3x3(x, w2s.astype(bf16), b2s), x2s),
+        "conv1_pallas_vjp": (lambda x: jax.grad(
+            lambda xx: conv3x3(xx, w1s.astype(bf16), b1s)
+            .astype(f32).sum())(x), x1s),
+        "conv2_pallas_vjp": (lambda x: jax.grad(
+            lambda xx: conv3x3(xx, w2s.astype(bf16), b2s)
+            .astype(f32).sum())(x), x2s),
+        "tail1_pallas": (
+            lambda y: fused_bn_relu_pool(y, gam1, bet1, 16, 4)[0], y1s),
+        "tail1_pallas_vjp": (lambda y: jax.grad(
+            lambda yy: fused_bn_relu_pool(yy, gam1, bet1, 16, 4)[0]
+            .astype(f32).sum())(y), y1s),
     })
 
     for name, (f, x0) in stages.items():
