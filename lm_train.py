@@ -105,14 +105,15 @@ def train(args):
         raise SystemExit(f"--clip-norm must be >= 0, got {args.clip_norm} "
                          "(negative max_norm would sign-flip every update)")
     if args.clip_norm:
-        if args.parallelism in ("pp", "3d"):
+        if args.parallelism in ("pp", "pp_sp", "3d"):
             # inside the pipeline's shard_map the 'stages' grads are
             # rank-local, so clip_by_global_norm would compute a DIFFERENT
             # norm per pipe rank and scale the replicated embed/head grads
             # inconsistently — silent divergence. Refuse until the engine
             # clips with a psum'd global norm.
             raise SystemExit(
-                "--clip-norm is not supported with --parallelism pp/3d "
+                "--clip-norm is not supported with --parallelism "
+                "pp/pp_sp/3d "
                 "(per-stage norms would diverge); clip under dp/tp/sp/ep"
             )
         tx = optax.chain(optax.clip_by_global_norm(args.clip_norm), tx)
@@ -158,6 +159,23 @@ def train(args):
         eng = PipelineParallel(cfg, tx, mesh, microbatches=args.microbatches,
                                circular_chunks=args.circular_chunks,
                                attention_fn=attention_fn)
+        state = eng.init_state(rng, sample)
+    elif p == "pp_sp":
+        # pipeline stages with the sequence sharded over 'sp' — ring (or
+        # flash-ring) attention inside each stage block; the long-context
+        # composition (activations ride the pipe as [mb, S/sp, D])
+        if n % 4:
+            raise SystemExit("pp_sp wants devices divisible by 4 "
+                             "(mesh data=2 x pipe=2 x sp=n/4)")
+        mesh = make_mesh({"data": 2, "pipe": 2, "sp": n // 4},
+                         devices=devices)
+        if cfg.n_layers % 2:
+            raise SystemExit("pp_sp needs even n_layers (2 stages)")
+        eng = PipelineParallel(
+            cfg, tx, mesh, microbatches=args.microbatches,
+            circular_chunks=args.circular_chunks, seq_axis="sp",
+            seq_attn="flash_ring" if args.flash else "ring",
+        )
         state = eng.init_state(rng, sample)
     elif p == "3d":
         # data x model x pipe: DP batch sharding, Megatron TP inside each
@@ -211,7 +229,7 @@ def build_parser() -> argparse.ArgumentParser:
     defaulted Namespaces instead of hand-building partial ones."""
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--parallelism",
-                        choices=["dp", "tp", "sp", "pp", "ep", "3d"],
+                        choices=["dp", "tp", "sp", "pp", "pp_sp", "ep", "3d"],
                         default="dp")
     parser.add_argument("--dp", type=int, default=1,
                         help="tp only: data-parallel axis size composed "

@@ -185,12 +185,15 @@ class PipelineParallel:
         data_axis: str = "data",
         pipe_axis: str = "pipe",
         model_axis: str | None = None,
+        seq_axis: str | None = None,
+        seq_attn: str = "ring",
         circular_chunks: int = 1,
         remat: bool = True,
         donate: bool = True,
         attention_fn: Callable | None = None,
     ):
-        axes = (data_axis, pipe_axis) + ((model_axis,) if model_axis else ())
+        axes = (data_axis, pipe_axis) + ((model_axis,) if model_axis else ()) \
+            + ((seq_axis,) if seq_axis else ())
         for ax in axes:
             if ax not in mesh.axis_names:
                 raise ValueError(f"axis {ax!r} not in mesh axes {mesh.axis_names}")
@@ -223,6 +226,38 @@ class PipelineParallel:
                     f"{config.n_heads} and d_ff={config.d_ff} must divide by "
                     f"{model_axis}={m}"
                 )
+        # sequence parallelism INSIDE the pipeline stages: activations ride
+        # the pipe as [mb, S/sp, D] slices and attention mixes positions
+        # across the 'sp' ring (ring_attention locates its shard itself via
+        # lax.axis_index, so it drops in as the per-block attention_fn;
+        # causality uses global positions). Embedding offsets positions per
+        # shard; the loss/grads add a pmean over 'sp' (equal shards ⇒ mean
+        # of local means is the global mean). Composes with model_axis:
+        # dp x tp x pp x sp on one mesh.
+        self.seq_axis = seq_axis
+        if seq_axis:
+            if attention_fn is not None:
+                raise ValueError(
+                    "seq_axis owns attention: pass seq_attn='ring'|"
+                    "'flash_ring' instead of attention_fn"
+                )
+            if seq_attn == "ring":
+                from tpu_sandbox.parallel.ring_attention import ring_attention
+
+                attention_fn = functools.partial(
+                    ring_attention, axis_name=seq_axis
+                )
+            elif seq_attn == "flash_ring":
+                from tpu_sandbox.parallel.flash_ring import (
+                    flash_ring_attention,
+                )
+
+                def attention_fn(q, k, v):
+                    return flash_ring_attention(q, k, v, seq_axis)
+            else:
+                raise ValueError(
+                    f"seq_attn must be 'ring' or 'flash_ring', got {seq_attn!r}"
+                )
         # attention_fn is injected through to every stage block (and the
         # init/parity twin) exactly as models.transformer.TransformerLM:89
         # accepts it — flash (O(S) memory) instead of the dense [S,S]
@@ -230,7 +265,10 @@ class PipelineParallel:
         # Params are attention_fn-independent, so checkpoints interchange.
         self.attention_fn = attention_fn
         self.block = Block(config, attention_fn)
-        self.model = TransformerLM(config, attention_fn)  # init / parity twin
+        # init / parity twin: ring attention only exists inside the
+        # shard_map (axis must be bound), so the twin stays dense there —
+        # params are attention_fn-independent either way
+        self.model = TransformerLM(config, None if seq_axis else attention_fn)
         self._build(donate)
 
     def bubble_fraction(self) -> float:
@@ -317,7 +355,10 @@ class PipelineParallel:
         )
 
     def shard_batch(self, tokens, targets):
-        sh = NamedSharding(self.mesh, P(self.data_axis))
+        sh = NamedSharding(
+            self.mesh, P(self.data_axis, self.seq_axis)
+            if self.seq_axis else P(self.data_axis)
+        )
         return (
             jax.device_put(jnp.asarray(tokens), sh),
             jax.device_put(jnp.asarray(targets), sh),
@@ -382,10 +423,16 @@ class PipelineParallel:
     def _build(self, donate: bool) -> None:
         cfg, n_stages, M = self.config, self.n_stages, self.microbatches
         daxis, paxis = self.data_axis, self.pipe_axis
+        saxis = self.seq_axis
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def embed(pre, tokens):
-            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+            # sequence-sharded: local slice covers global positions
+            # [sp_idx*s_local, ...) — pos_emb must see the global index
+            base = lax.axis_index(saxis) * tokens.shape[1] if saxis else 0
+            positions = jnp.broadcast_to(
+                base + jnp.arange(tokens.shape[1]), tokens.shape
+            )
             tok = pre["tok_emb"]["embedding"][tokens]
             pos = pre["pos_emb"]["embedding"][positions]
             return (tok + pos).astype(cfg.dtype)
@@ -482,6 +529,13 @@ class PipelineParallel:
             }
             grads = lax.pmean(grads, daxis)
             loss = lax.pmean(lax.psum(loss, paxis), daxis)
+            if saxis:
+                # each sp shard's CE is the mean over ITS positions and its
+                # param grads are the partials of that local mean (attention
+                # cross-terms already routed by the ring's VJP): with equal
+                # shards, the global mean is the mean of local means
+                grads = lax.pmean(grads, saxis)
+                loss = lax.pmean(loss, saxis)
             updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
             return (
                 state.replace(
@@ -498,10 +552,12 @@ class PipelineParallel:
 
     def _compile_for(self, state: TrainState) -> Callable:
         specs = self._state_specs(state)
+        bspec = (P(self.data_axis, self.seq_axis) if self.seq_axis
+                 else P(self.data_axis))
         smapped = jax.shard_map(
             self._body,
             mesh=self.mesh,
-            in_specs=(specs, P(self.data_axis), P(self.data_axis)),
+            in_specs=(specs, bspec, bspec),
             out_specs=(specs, P()),
             check_vma=False,
         )
