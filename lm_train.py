@@ -5,11 +5,13 @@ and attention "ABSENT"); this entry script is the showcase for the
 capabilities the TPU build adds on top — the same decoder-only LM trained
 under any of:
 
-  dp  — DataParallel-equivalent via PjitEngine (batch sharded on 'data')
-  tp  — tensor parallel: qkv/mlp kernels sharded on 'model'
-  sp  — sequence parallel: ring attention over 'sp' (long context)
-  pp  — pipeline parallel: GPipe microbatches over 'pipe'
-  ep  — expert parallel: switch-MoE, expert weights sharded on 'expert'
+  dp    — DataParallel-equivalent via PjitEngine (batch sharded on 'data')
+  tp    — tensor parallel: qkv/mlp kernels sharded on 'model'
+  sp    — sequence parallel: ring attention over 'sp' (long context)
+  pp    — pipeline parallel: GPipe microbatches over 'pipe'
+  pp_sp — pipeline stages with the sequence sharded over 'sp' (ring or
+          flash-ring attention inside every stage block)
+  ep    — expert parallel: switch-MoE, expert weights sharded on 'expert'
 
 Data is a deterministic synthetic character stream (zero egress): the task
 is modular next-token prediction, which a small LM drives to near-zero loss
