@@ -145,3 +145,35 @@ def test_pallas_ce_huge_vocab_falls_back_to_jnp():
     v_ref, g_ref = jax.value_and_grad(cross_entropy_loss)(logits, labels)
     np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-7)
+
+
+def test_bench_plan_ladder():
+    """The bench's execution-plan fallback ladder (bench.py): first
+    working rung wins; fallback rungs are labeled with the triggering
+    error; total failure returns a degraded record, never raises."""
+    import sys
+    sys.path.insert(0, ".")
+    from bench import run_plan_ladder
+
+    # first rung works
+    r = run_plan_ladder(lambda o: {"value": 1, "overrides": dict(o)})
+    assert r["value"] == 1 and "plan_fallback" not in r
+
+    # fused plans fail, unfused rung succeeds and is labeled
+    def run(overrides):
+        if overrides.get("fused_conv", True):
+            raise RuntimeError("Mosaic says no")
+        return {"value": 2, "overrides": dict(o := overrides)}
+
+    r = run_plan_ladder(run)
+    assert r["value"] == 2
+    assert "Mosaic says no" in r["plan_fallback"]
+    assert "conv kernels disabled" in r["plan_fallback"]
+
+    # everything fails: degraded record, no exception
+    def boom(overrides):
+        raise ValueError("total kernel failure")
+
+    r = run_plan_ladder(boom)
+    assert r["value"] == 0.0
+    assert "total kernel failure" in r["degraded"]
