@@ -302,6 +302,11 @@ def test_pipeline_sp_validates():
     with pytest.raises(ValueError, match="seq_attn must be"):
         PipelineParallel(CFG, optax.sgd(0.1), mesh, microbatches=2,
                          seq_axis="sp", seq_attn="bogus")
+    pp = PipelineParallel(CFG, optax.sgd(0.1), mesh, microbatches=2,
+                          seq_axis="sp")
+    bad = np.zeros((4, 15), np.int32)  # S=15 not divisible by sp=2
+    with pytest.raises(ValueError, match="not divisible by the sp=2"):
+        pp.shard_batch(bad, bad)
 
 
 def test_pipeline_validates(mesh_dp_pp):

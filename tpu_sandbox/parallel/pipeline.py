@@ -355,6 +355,14 @@ class PipelineParallel:
         )
 
     def shard_batch(self, tokens, targets):
+        b, s = jnp.shape(tokens)
+        if self.seq_axis:
+            n_sp = self.mesh.shape[self.seq_axis]
+            if s % n_sp:
+                raise ValueError(
+                    f"sequence length {s} not divisible by the "
+                    f"{self.seq_axis}={n_sp} shards"
+                )
         sh = NamedSharding(
             self.mesh, P(self.data_axis, self.seq_axis)
             if self.seq_axis else P(self.data_axis)
