@@ -897,6 +897,19 @@ def main():
                       for k in used if requested[k] != used[k]}
         result["degraded"] = ("accelerator unavailable; CPU fallback "
                               f"overrode {overridden or 'nothing'}")
+        # the round artifact should not be information-free when the
+        # tunnel is down: carry the current plan's chipless AOT floors,
+        # explicitly labeled as estimates (BASELINE.md holds the analysis)
+        result["estimated_not_measured"] = {
+            "plan": "s2d + pallas conv/tail kernels, bs=16 bf16",
+            "aot_bytes_accessed_gb": 27.2,
+            "aot_bw_floor_ms_per_step": 33.2,
+            "compute_floor_ms_per_step": 48,
+            "expected_images_per_sec_measured": "270-350 (~4x baseline)",
+            "source": "chipless v5e AOT compile + kernel-shape analysis "
+                      "(measured/aot_s2d_fusedconv_b16.jsonl, BASELINE.md "
+                      "'The 10x target, argued')",
+        }
     else:
         result = run_plan_ladder(
             lambda overrides: bench(
