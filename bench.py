@@ -120,16 +120,17 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
     tx = optax.sgd(1e-4)
     global_batch = batch_per_device * n_dev
 
-    images, labels = synthetic_mnist(n=global_batch * 8, seed=0)
+    images, labels = synthetic_mnist(n=global_batch * 64, seed=0)
     images, labels = normalize(images), labels.astype("int32")
     # The blob task is linearly separable and saturates to loss 0.0 within
     # the warmup (VERDICT r01/r02: a dead loss demonstrates nothing about
     # the timed window). 25% uniform label flips (effective corruption
-    # 22.5%) put a ~1.0-nat CE floor under any non-memorizing fit, so the
-    # published final_loss stays live over bench-length runs; a very long
-    # run could still memorize the fixed flipped labels of this small
-    # staged set, so the floor is a practical one, not information-
-    # theoretic. Shapes/FLOPs/traffic are untouched.
+    # 22.5%) put a ~1.0-nat CE floor under any non-memorizing fit. The
+    # first on-chip r03 run still printed 0.0: with only 8 staged batches
+    # the 180M-param head saw each fixed flipped label ~24 times and
+    # memorized it. 64 staged batches (raw 28x28, ~4 KB each — resize is
+    # on-device) cap reuse at ~3 epochs over a bench run, keeping the
+    # floor practical. Shapes/FLOPs/traffic are untouched.
     noise_rng = np.random.default_rng(1)
     flip = noise_rng.random(len(labels)) < 0.25
     labels = np.where(
@@ -148,15 +149,22 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
     # on device inside the step).
     rng = np.random.default_rng(0)
     staged = []
-    for _ in range(8):
+    for _ in range(64):
         sel = rng.integers(0, len(images), size=global_batch)
         staged.append(dp.shard_batch(images[sel], labels[sel]))
 
+    cursor = 0
+
     def run_steps(k: int):
-        nonlocal state
+        # persistent cursor: the staged pool must cycle ACROSS calls, or
+        # measure_per_step's repeated run_steps(n) would retrain the same
+        # leading batches every call and final_loss would be evaluated on
+        # the most-memorized batch — the failure the 64-batch pool fixes
+        nonlocal state, cursor
         loss = None
-        for i in range(k):
-            im, lb = staged[i % len(staged)]
+        for _ in range(k):
+            im, lb = staged[cursor % len(staged)]
+            cursor += 1
             state, loss = dp.train_step(state, im, lb)
         return loss
 
@@ -687,10 +695,24 @@ def bench_pallas(force_cpu: bool) -> dict:
         q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), dtype=dt)
                    for _ in range(3))
         out = flash_attention(q, k, v, interpret=interpret)
-        ref = causal_attention(q, k, v)
+        # Reference at HIGHEST matmul precision: on TPU the default f32
+        # einsum rounds operands to bf16 on the MXU, which would make the
+        # reference as noisy as the thing under test.
+        with jax.default_matmul_precision("highest"):
+            ref = causal_attention(q, k, v)
         err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
                                     - ref.astype(jnp.float32))))
-        tol = 2e-2 if dt == "bfloat16" else 2e-3
+        # f32 bound on TPU is the MXU operand-rounding floor (the kernel
+        # feeds the systolic array bf16-rounded inputs with f32
+        # accumulation; one rounding step is 2^-8 relative, amplified ~2x
+        # through softmax) — measured 6.5e-3 on v5e. It is NOT an
+        # accumulation-bug budget: interpret mode has no MXU rounding, so
+        # the CPU path keeps the tight bound and still catches real
+        # accumulation regressions off-chip.
+        if dt == "bfloat16":
+            tol = 2e-2
+        else:
+            tol = 1.5e-2 if on_tpu else 2e-3
         assert err < tol, (b, s, h, d, dt, err)
         checks[f"flash_s{s}_{dt}"] = err
 
@@ -899,17 +921,22 @@ def main():
                               f"overrode {overridden or 'nothing'}")
         # the round artifact should not be information-free when the
         # tunnel is down: carry the current plan's chipless AOT floors,
-        # explicitly labeled as estimates (BASELINE.md holds the analysis)
-        result["estimated_not_measured"] = {
-            "plan": "s2d + pallas conv/tail kernels, bs=16 bf16",
-            "aot_bytes_accessed_gb": 27.2,
-            "aot_bw_floor_ms_per_step": 33.2,
-            "compute_floor_ms_per_step": 48,
-            "expected_images_per_sec_measured": "270-350 (~4x baseline)",
-            "source": "chipless v5e AOT compile + kernel-shape analysis "
-                      "(measured/aot_s2d_fusedconv_b16.jsonl, BASELINE.md "
-                      "'The 10x target, argued')",
-        }
+        # explicitly labeled as estimates (BASELINE.md holds the analysis).
+        # The analysis is for the s2d+kernels bf16 plan only — attaching
+        # it to a --plan plain or fp32 line would misattribute it.
+        s2d_resolves = (args.plan == "s2d"
+                        or (args.plan == "auto" and args.image_size % 4 == 0))
+        if s2d_resolves and args.dtype == "bf16":
+            result["estimated_not_measured"] = {
+                "plan": "s2d + pallas conv/tail kernels, bs=16 bf16",
+                "aot_bytes_accessed_gb": 27.2,
+                "aot_bw_floor_ms_per_step": 33.2,
+                "compute_floor_ms_per_step": 48,
+                "expected_images_per_sec_measured": "270-350 (~4x baseline)",
+                "source": "chipless v5e AOT compile + kernel-shape analysis "
+                          "(measured/aot_s2d_fusedconv_b16.jsonl, BASELINE.md "
+                          "'The 10× target, argued')",
+            }
     else:
         result = run_plan_ladder(
             lambda overrides: bench(

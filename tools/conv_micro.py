@@ -1,0 +1,171 @@
+"""Per-kernel conv micro-benchmark at the s2d plan's REAL shapes.
+
+Round-3 motive: the first on-chip run of the fused-conv plan measured
+254 ms/step at bs=16 against a 33 ms AOT traffic floor and a ~48 ms
+compute floor (BASELINE.md "The 10x target, argued") — the Pallas convs
+are executing near ~21 TF/s where the shape analysis predicted ~110.
+This tool separates WHICH kernel (conv1/conv2 x fwd/bwd, Pallas vs the
+XLA lax.conv it replaced) eats the step, with the same fetch-synced
+differential timing as bench.py, so the optimization targets the
+measured hot spot instead of the estimate.
+
+Usage (chip): python tools/conv_micro.py [--batch 16] [--ops conv1_fwd,...]
+Writes one JSON line per timed op to stdout.
+
+Shapes (models/convnet_s2d.py, 3000^2 input):
+  conv1: x [B,750,750,16]  w [3,3,16,256]   (r=4 scatter of 5x5 1->16)
+  conv2: x [B,750,750,64]  w [3,3,64,128]   (r=2 scatter of 5x5 16->32)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--ops", type=str, default="")
+    p.add_argument("--hw", type=int, default=750)
+    p.add_argument("--force-cpu", action="store_true",
+                   help="smoke-test the tool off-chip (interpret-mode "
+                        "kernels; timings are not TPU claims). NEVER run "
+                        "this tool on the chip while another bench holds "
+                        "it — a mid-compile kill wedges the tunnel.")
+    args = p.parse_args()
+
+    if args.force_cpu:
+        from tpu_sandbox.utils.cli import ensure_devices
+        ensure_devices(1, force_cpu=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_sandbox.ops.pallas_conv import (
+        _flip_transpose,
+        conv3x3,
+        conv3x3_reference,
+        conv3x3_stats,
+    )
+    from tpu_sandbox.utils.profiling import host_sync, measure_per_step
+
+    b, hw = args.batch, args.hw
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+
+    def mk(shape, dt=jnp.bfloat16):
+        # standard_normal(dtype=f32): rng.normal would stage a float64
+        # host transient (~4.6 GB for conv2 at bs=16) next to a live chip
+        return jnp.asarray(
+            rng.standard_normal(size=shape, dtype=np.float32) * 0.1, dt)
+
+    shapes = {
+        "conv1": dict(x=(b, hw, hw, 16), w=(3, 3, 16, 256)),
+        "conv2": dict(x=(b, hw, hw, 64), w=(3, 3, 64, 128)),
+    }
+
+    def fwd_flops(x, w):
+        bb, h, wd, c = x
+        return 2 * bb * h * wd * 9 * c * w[-1]
+
+    def time_op(name, step_fn, flops, traffic_bytes):
+        """step_fn(acc)->acc must data-depend on acc and return a scalar."""
+        jstep = jax.jit(step_fn)
+
+        def run_steps(k):
+            acc = jnp.float32(0.0)
+            for _ in range(k):
+                acc = jstep(acc)
+            return acc
+
+        t = measure_per_step(run_steps, args.iters)
+        spc = t["sec_per_step"]
+        rec = {
+            "op": name, "batch": b, "sec_per_call": round(spc, 6),
+            "tflops": round(flops / spc / 1e12, 2) if spc > 0 else None,
+            "hbm_gbps": round(traffic_bytes / spc / 1e9, 1)
+            if spc > 0 else None,
+            "flops": flops, "traffic_bytes_min": traffic_bytes,
+            "device_kind": str(dev.device_kind),
+            "timing_method": t["timing_method"],
+        }
+        print(json.dumps(rec), flush=True)
+
+    want = set(filter(None, args.ops.split(",")))
+
+    for cname, sh in shapes.items():
+        x = mk(sh["x"])
+        w = mk(sh["w"])
+        bias = mk((sh["w"][-1],))
+        fl = fwd_flops(sh["x"], sh["w"])
+        nbytes = lambda s: int(np.prod(s)) * 2
+        io_fwd = nbytes(sh["x"]) + nbytes(sh["x"][:3] + (sh["w"][-1],))
+
+        # -------- forward: pallas (stats variant = production), pallas
+        # plain, and the XLA conv it replaced --------
+        if not want or f"{cname}_fwd" in want:
+            def s_pallas(acc, x=x, w=w, bias=bias):
+                y, s, ss = conv3x3_stats(x + acc.astype(x.dtype), w, bias)
+                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+            time_op(f"{cname}_fwd_pallas_stats", s_pallas, fl, io_fwd)
+
+            def s_plain(acc, x=x, w=w, bias=bias):
+                y = conv3x3(x + acc.astype(x.dtype), w, bias)
+                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+            time_op(f"{cname}_fwd_pallas", s_plain, fl, io_fwd)
+
+            def s_xla(acc, x=x, w=w, bias=bias):
+                y = conv3x3_reference(x + acc.astype(x.dtype), w, bias)
+                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+            time_op(f"{cname}_fwd_xla", s_xla, fl, io_fwd)
+
+        # -------- backward (dx+dw+db together, via vjp), pallas vs XLA ----
+        if not want or f"{cname}_bwd" in want:
+            g = mk(sh["x"][:3] + (sh["w"][-1],))
+
+            def s_bwd(acc, x=x, w=w, bias=bias, g=g):
+                _, vjp = jax.vjp(
+                    lambda xx, ww, bb: conv3x3(xx, ww, bb),
+                    x + acc.astype(x.dtype), w, bias)
+                dx, dw, db = vjp(g)
+                return (dx[0, 0, 0, 0].astype(jnp.float32)
+                        + dw[0, 0, 0, 0].astype(jnp.float32)) * 1e-6
+            time_op(f"{cname}_bwd_pallas", s_bwd, 2 * fl,
+                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape))
+
+            def s_bwd_xla(acc, x=x, w=w, bias=bias, g=g):
+                _, vjp = jax.vjp(
+                    lambda xx, ww, bb: conv3x3_reference(xx, ww, bb),
+                    x + acc.astype(x.dtype), w, bias)
+                dx, dw, db = vjp(g)
+                return (dx[0, 0, 0, 0].astype(jnp.float32)
+                        + dw[0, 0, 0, 0].astype(jnp.float32)) * 1e-6
+            time_op(f"{cname}_bwd_xla", s_bwd_xla, 2 * fl,
+                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape))
+
+        # -------- dgrad alone (fwd kernel, flipped weights) --------
+        if not want or f"{cname}_dgrad" in want:
+            g = mk(sh["x"][:3] + (sh["w"][-1],))
+            wf = _flip_transpose(w)
+            zb = jnp.zeros((sh["x"][-1],), g.dtype)
+
+            def s_dgrad(acc, g=g, wf=wf, zb=zb):
+                y = conv3x3(g + acc.astype(g.dtype), wf, zb)
+                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+            time_op(f"{cname}_dgrad_pallas", s_dgrad,
+                    fwd_flops(g.shape, wf.shape),
+                    nbytes(g.shape) + nbytes(sh["x"]))
+
+    print(json.dumps({"note": "pair tflops against the shape's MXU "
+                              "ceiling and hbm_gbps against ~819 GB/s "
+                              "(v5e) to see which wall each kernel hits"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
