@@ -268,23 +268,39 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
     the best honest images/sec. OOM configs are recorded as rows, not
     errors (the capacity boundary is part of the table)."""
     if quick:
-        image_size, configs = 128, [("fp32", 2), ("fp32", 4)]
+        image_size, configs = 128, [("fp32", 2, None, None),
+                                    ("fp32", 4, None, None)]
     else:
         # ladder chosen around the chipless AOT capacity estimates for the
         # s2d plan with the fused tail (bs=16 fits at ~15.3 GB peak, bs=17+
         # OOMs; measured/aot_capacity_s2d_fused.jsonl): dense near the
         # expected best point, plus one past-capacity row so the OOM
-        # boundary lands in the table
-        configs = [("bf16", 5), ("bf16", 8), ("bf16", 12), ("bf16", 16),
-                   ("bf16", 20), ("fp32", 5)]
+        # boundary lands in the table. The kernel-plan rows race the three
+        # execution plans at the best batch — the first r03 chip run
+        # measured the Pallas-conv plan ~5x over its AOT floor, so which
+        # plan actually wins on hardware is an open measured question.
+        configs = [("bf16", 5, None, None), ("bf16", 8, None, None),
+                   ("bf16", 12, None, None), ("bf16", 16, None, None),
+                   ("bf16", 20, None, None), ("fp32", 5, None, None)]
+        from tpu_sandbox.models import resolves_to_s2d
+        if resolves_to_s2d(image_size, plan):
+            # the overrides are meaningless under the plain plan — labeled
+            # race rows there would publish three copies of the same run
+            configs += [
+                ("bf16", 16, dict(fused_conv=False), "xla_conv+tail"),
+                ("bf16", 16, dict(fused_conv=False, fused_tail=False),
+                 "xla_conv_unfused"),
+                ("bf16", 5, dict(fused_conv=False), "xla_conv+tail")]
     rows, best = [], None
-    for dtype_name, bs in configs:
+    for dtype_name, bs, overrides, plan_label in configs:
         try:
             r = bench(image_size, bs, steps, warmup, dtype_name, force_cpu,
-                      baseline, plan=plan)
+                      baseline, plan=plan, model_overrides=overrides)
             row = {"dtype": dtype_name, "batch": bs,
                    "sec_per_step": r["sec_per_step"],
                    "images_per_sec": r["value"], "mfu": r["mfu"]}
+            if plan_label:
+                row["kernel_plan"] = plan_label
             if "degraded" in r:
                 row["degraded"] = r["degraded"]
             elif best is None or r["value"] > best["images_per_sec"]:
@@ -294,6 +310,8 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
             oom = _is_oom(msg)
             row = {"dtype": dtype_name, "batch": bs,
                    "oom" if oom else "error": True if oom else msg[:200]}
+            if plan_label:
+                row["kernel_plan"] = plan_label
         rows.append(row)
 
     import jax
@@ -924,9 +942,8 @@ def main():
         # explicitly labeled as estimates (BASELINE.md holds the analysis).
         # The analysis is for the s2d+kernels bf16 plan only — attaching
         # it to a --plan plain or fp32 line would misattribute it.
-        s2d_resolves = (args.plan == "s2d"
-                        or (args.plan == "auto" and args.image_size % 4 == 0))
-        if s2d_resolves and args.dtype == "bf16":
+        from tpu_sandbox.models import resolves_to_s2d
+        if resolves_to_s2d(args.image_size, args.plan) and args.dtype == "bf16":
             result["estimated_not_measured"] = {
                 "plan": "s2d + pallas conv/tail kernels, bs=16 bf16",
                 "aot_bytes_accessed_gb": 27.2,

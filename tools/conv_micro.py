@@ -37,6 +37,11 @@ def main() -> None:
                         "kernels; timings are not TPU claims). NEVER run "
                         "this tool on the chip while another bench holds "
                         "it — a mid-compile kill wedges the tunnel.")
+    p.add_argument("--trace", type=str, default="",
+                   help="directory for a jax.profiler trace of each timed "
+                        "op (best-effort: the tunneled TPU may not "
+                        "support device tracing; the timing numbers above "
+                        "are the source of truth either way)")
     args = p.parse_args()
 
     if args.force_cpu:
@@ -52,7 +57,11 @@ def main() -> None:
         conv3x3_reference,
         conv3x3_stats,
     )
-    from tpu_sandbox.utils.profiling import host_sync, measure_per_step
+    from tpu_sandbox.utils.profiling import (
+        host_sync,
+        measure_per_step,
+        trace as profiling_trace,
+    )
 
     b, hw = args.batch, args.hw
     rng = np.random.default_rng(0)
@@ -85,6 +94,15 @@ def main() -> None:
 
         t = measure_per_step(run_steps, args.iters)
         spc = t["sec_per_step"]
+        if args.trace:
+            try:
+                with profiling_trace(os.path.join(args.trace, name)):
+                    host_sync(run_steps(2))
+            except Exception as e:  # tracing is best-effort diagnostics
+                print(json.dumps({"op": name,
+                                  "trace_failed": f"{type(e).__name__}: "
+                                                  f"{str(e)[:200]}"}),
+                      flush=True)
         rec = {
             "op": name, "batch": b, "sec_per_call": round(spc, 6),
             "tflops": round(flops / spc / 1e12, 2) if spc > 0 else None,
@@ -94,6 +112,10 @@ def main() -> None:
             "device_kind": str(dev.device_kind),
             "timing_method": t["timing_method"],
         }
+        if spc <= 0:
+            # same rule as bench.py: a non-positive differential is timing
+            # jitter, not a measurement — never rank kernels by this row
+            rec["degraded"] = "non-positive differential; noise, not a time"
         print(json.dumps(rec), flush=True)
 
     want = set(filter(None, args.ops.split(",")))

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Probe the wedged tunnel every 4 min (subprocess probe, never bare
+# jax.devices()); when it answers, run the round-3 rerun ladder
+# sequentially. ONE chip process at a time — nothing else may touch the
+# chip while this runs (see memory: tpu-chip-discipline).
+cd "$(dirname "$0")/.." || exit 1
+log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
+
+log "RECOVERY WATCH started"
+while true; do
+  if python -c "import bench,sys; sys.exit(0 if bench.accelerator_usable() else 1)" 2>/dev/null; then
+    break
+  fi
+  sleep 240
+done
+log "chip recovered; rerun ladder starting"
+
+log "R0 conv_micro (per-kernel diagnosis, bs=16)"
+timeout 3000 python tools/conv_micro.py --batch 16 > measured/conv_micro_r03.jsonl 2> measured/conv_micro_r03.err
+log "R0 exit $?"
+
+log "R1 pallas (fixed f32 tol)"
+timeout 1800 python bench.py --metric pallas > measured/pallas_r03.json 2> measured/pallas_r03.err
+log "R1 exit $?"
+
+log "R2 lm (dots remat, b16)"
+timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r03.json 2> measured/lm_dots_b16_r03.err
+log "R2 exit $?"
+
+log "R3 capacity"
+timeout 2400 python bench.py --metric capacity > measured/capacity_r03.json 2> measured/capacity_r03.err
+log "R3 exit $?"
+
+log "R4 sweep"
+timeout 3600 python bench.py --metric sweep --steps 5 > measured/sweep_r03.json 2> measured/sweep_r03.err
+log "R4 exit $?"
+
+log "R5 seq_scaling"
+timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r03.json 2> measured/seq_scaling_r03.err
+log "R5 exit $?"
+
+log "RERUN LADDER DONE"

@@ -2,6 +2,17 @@ from tpu_sandbox.models.convnet import ConvNet  # noqa: F401
 from tpu_sandbox.models.convnet_s2d import ConvNetS2D  # noqa: F401
 
 
+def resolves_to_s2d(image_size, plan: str = "auto") -> bool:
+    """Single home for the auto-plan rule: does this (image_size, plan)
+    request run the s2d execution plan? Callers that label or annotate
+    results by plan (bench sweep's kernel race, the degraded line's AOT
+    estimate block) must use this rather than re-deriving the rule."""
+    h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
+    return plan != "plain" and (
+        plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0)
+    )
+
+
 def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     """The execution-plan switch: ConvNetS2D (space-to-depth, the TPU fast
     path — see models/convnet_s2d.py) when the plan applies, else the plain
@@ -18,9 +29,7 @@ def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
     fused = kwargs.pop("fused_tail", None)
     fused_conv = kwargs.pop("fused_conv", None)
-    if plan != "plain" and (
-        plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0)
-    ):
+    if resolves_to_s2d(image_size, plan):
         if fused is None or fused_conv is None:
             from tpu_sandbox.ops.pallas_common import default_interpret
 
