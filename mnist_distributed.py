@@ -323,12 +323,16 @@ def main():
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1: shard optimizer state over the data "
                              "axis (same math, 1/N the optimizer memory)")
-    parser.add_argument("--plan", choices=["auto", "s2d", "plain"],
+    parser.add_argument("--plan",
+                        choices=["auto", "s2dt", "s2d", "plain"],
                         default="auto",
-                        help="ConvNet execution plan: s2d = space-to-depth "
-                             "TPU fast path (models/convnet_s2d.py, same "
-                             "function as the plain net - tested); auto "
-                             "picks s2d when the image size allows")
+                        help="ConvNet execution plan: s2dt = transposed "
+                             "space-to-depth (models/convnet_s2d_t.py), "
+                             "s2d = NHWC space-to-depth "
+                             "(models/convnet_s2d.py) - same function as "
+                             "the plain net either way, tested; auto "
+                             "picks s2dt on TPU when the image "
+                             "size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
     parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
                         help="with --ckpt-dir: also save every N steps")

@@ -148,12 +148,16 @@ def main():
                              "sequential microbatches (OOM workaround on ONE "
                              "device — the counterpart of the reference's "
                              "DDP batch split, README OOM experiment)")
-    parser.add_argument("--plan", choices=["auto", "s2d", "plain"],
+    parser.add_argument("--plan",
+                        choices=["auto", "s2dt", "s2d", "plain"],
                         default="auto",
-                        help="ConvNet execution plan: s2d = space-to-depth "
-                             "TPU fast path (models/convnet_s2d.py, same "
-                             "function as the plain net - tested); auto "
-                             "picks s2d when the image size allows")
+                        help="ConvNet execution plan: s2dt = transposed "
+                             "space-to-depth (models/convnet_s2d_t.py), "
+                             "s2d = NHWC space-to-depth "
+                             "(models/convnet_s2d.py) - same function as "
+                             "the plain net either way, tested; auto "
+                             "picks s2dt on TPU when the image "
+                             "size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16",
                         help="compute dtype; params and loss stay fp32")
     parser.add_argument("--native-loader", action="store_true",

@@ -177,3 +177,21 @@ def test_bench_plan_ladder():
     r = run_plan_ladder(boom)
     assert r["value"] == 0.0
     assert "total kernel failure" in r["degraded"]
+
+    # rung dedup: --plan s2d makes the transposed rung byte-identical to
+    # the first; it must not be re-run (code-review r03 finding)
+    calls = []
+
+    def record(overrides):
+        calls.append(dict(overrides))
+        raise RuntimeError("fail every rung")
+
+    run_plan_ladder(record, plan="s2d")
+    assert calls == [{}, {"plan": "s2d", "fused_conv": False},
+                     {"plan": "s2d", "fused_conv": False,
+                      "fused_tail": False}]
+
+    # an explicit plain request is never escalated to an s2d rung
+    calls.clear()
+    run_plan_ladder(record, plan="plain")
+    assert calls == [{}]

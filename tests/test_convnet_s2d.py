@@ -30,11 +30,17 @@ def _data(n=3, hw=48, seed=0):
 
 
 def test_pick_convnet_plan_switch():
-    from tpu_sandbox.models import pick_convnet
+    from tpu_sandbox.models import pick_convnet, resolve_plan
+    # on CPU (interpret-mode kernels) auto resolves to the NHWC s2d plan;
+    # on TPU / forced-compile it resolves to the transposed plan
     assert type(pick_convnet(3000)).__name__ == "ConvNetS2D"
     assert type(pick_convnet(3000, plan="plain")).__name__ == "ConvNet"
     assert type(pick_convnet(3001)).__name__ == "ConvNet"  # not 4-divisible
     assert type(pick_convnet((128, 64))).__name__ == "ConvNetS2D"
+    assert type(pick_convnet(3000, plan="s2dt")).__name__ == "ConvNetS2DT"
+    assert resolve_plan(3000) == "s2d"          # CPU test backend
+    assert resolve_plan(3000, "s2dt") == "s2dt"
+    assert resolve_plan(3001) == "plain"
 
 
 def test_param_trees_compatible():

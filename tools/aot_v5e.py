@@ -109,7 +109,8 @@ def analyze(compiled, plan: str, batch: int, remat: bool = False) -> dict:
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--plan", choices=["s2d", "plain"], default="s2d")
+    p.add_argument("--plan", choices=["s2dt", "s2d", "plain"],
+                   default="s2dt")
     p.add_argument("--batch", type=int, default=5)
     p.add_argument("--image-size", type=int, default=3000)
     p.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")

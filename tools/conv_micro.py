@@ -57,6 +57,11 @@ def main() -> None:
         conv3x3_reference,
         conv3x3_stats,
     )
+    from tpu_sandbox.ops.pallas_conv_t import (
+        conv3x3_t,
+        conv3x3_t_stats,
+        conv3x3_t_wgrad,
+    )
     from tpu_sandbox.utils.profiling import (
         host_sync,
         measure_per_step,
@@ -82,14 +87,18 @@ def main() -> None:
         bb, h, wd, c = x
         return 2 * bb * h * wd * 9 * c * w[-1]
 
-    def time_op(name, step_fn, flops, traffic_bytes):
-        """step_fn(acc)->acc must data-depend on acc and return a scalar."""
+    def time_op(name, step_fn, flops, traffic_bytes, *ops):
+        """step_fn(acc, *ops)->scalar must data-depend on acc. The
+        operands are REAL jit arguments, not closure captures: captured
+        arrays bake into the HLO as constants, and the tunnel's
+        remote-compile HTTP request then ships them (288 MB at bs=16 ->
+        HTTP 413 'length limit exceeded', observed on-chip)."""
         jstep = jax.jit(step_fn)
 
         def run_steps(k):
             acc = jnp.float32(0.0)
             for _ in range(k):
-                acc = jstep(acc)
+                acc = jstep(acc, *ops)
             return acc
 
         t = measure_per_step(run_steps, args.iters)
@@ -130,45 +139,121 @@ def main() -> None:
 
         # -------- forward: pallas (stats variant = production), pallas
         # plain, and the XLA conv it replaced --------
+        # The timed scalar must be a FULL reduction of every computed
+        # array: an element slice like y[0,0,0,0] lets XLA push the slice
+        # through the conv and compute a handful of pixels — observed
+        # on-chip as conv1_bwd_xla "321 TF/s" (> the 197 peak). The sum
+        # adds one fused output pass to both sides identically.
+        def red(a):
+            return jnp.sum(a.astype(jnp.float32)) * 1e-9
+
         if not want or f"{cname}_fwd" in want:
-            def s_pallas(acc, x=x, w=w, bias=bias):
+            def s_pallas(acc, x, w, bias):
                 y, s, ss = conv3x3_stats(x + acc.astype(x.dtype), w, bias)
-                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
-            time_op(f"{cname}_fwd_pallas_stats", s_pallas, fl, io_fwd)
+                return red(y)
+            time_op(f"{cname}_fwd_pallas_stats", s_pallas, fl, io_fwd,
+                    x, w, bias)
 
-            def s_plain(acc, x=x, w=w, bias=bias):
+            def s_plain(acc, x, w, bias):
                 y = conv3x3(x + acc.astype(x.dtype), w, bias)
-                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
-            time_op(f"{cname}_fwd_pallas", s_plain, fl, io_fwd)
+                return red(y)
+            time_op(f"{cname}_fwd_pallas", s_plain, fl, io_fwd, x, w, bias)
 
-            def s_xla(acc, x=x, w=w, bias=bias):
+            def s_xla(acc, x, w, bias):
                 y = conv3x3_reference(x + acc.astype(x.dtype), w, bias)
-                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
-            time_op(f"{cname}_fwd_xla", s_xla, fl, io_fwd)
+                return red(y)
+            time_op(f"{cname}_fwd_xla", s_xla, fl, io_fwd, x, w, bias)
 
         # -------- backward (dx+dw+db together, via vjp), pallas vs XLA ----
         if not want or f"{cname}_bwd" in want:
             g = mk(sh["x"][:3] + (sh["w"][-1],))
 
-            def s_bwd(acc, x=x, w=w, bias=bias, g=g):
+            def s_bwd(acc, x, w, bias, g):
                 _, vjp = jax.vjp(
                     lambda xx, ww, bb: conv3x3(xx, ww, bb),
                     x + acc.astype(x.dtype), w, bias)
                 dx, dw, db = vjp(g)
-                return (dx[0, 0, 0, 0].astype(jnp.float32)
-                        + dw[0, 0, 0, 0].astype(jnp.float32)) * 1e-6
+                return red(dx) + red(dw) + red(db)
             time_op(f"{cname}_bwd_pallas", s_bwd, 2 * fl,
-                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape))
+                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape),
+                    x, w, bias, g)
 
-            def s_bwd_xla(acc, x=x, w=w, bias=bias, g=g):
+            def s_bwd_xla(acc, x, w, bias, g):
                 _, vjp = jax.vjp(
                     lambda xx, ww, bb: conv3x3_reference(xx, ww, bb),
                     x + acc.astype(x.dtype), w, bias)
                 dx, dw, db = vjp(g)
-                return (dx[0, 0, 0, 0].astype(jnp.float32)
-                        + dw[0, 0, 0, 0].astype(jnp.float32)) * 1e-6
+                return red(dx) + red(dw) + red(db)
             time_op(f"{cname}_bwd_xla", s_bwd_xla, 2 * fl,
-                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape))
+                    2 * nbytes(sh["x"]) + 2 * nbytes(g.shape),
+                    x, w, bias, g)
+
+        # -------- transposed-layout kernels (pallas_conv_t): x [B,H,C,W]
+        # — the round-3 rework; same math, channels on sublanes. The
+        # big device arrays are shared across sections and dropped per
+        # conv: per-section fresh 4.6 GB cotangents accumulated across
+        # sections OOM'd the 16 GB chip on the first run --------
+        t_ops = {f"{cname}_{o}" for o in
+                 ("fwd_t", "bwd_t", "wgrad_t", "dgrad_t")}
+        g_ops = t_ops - {f"{cname}_fwd_t"}
+        if not want or (want & t_ops):
+            xt = mk((sh["x"][0], sh["x"][1], sh["x"][3], sh["x"][2]))
+        if not want or (want & g_ops):
+            # only when a backward op needs it: at conv1 bs=16 this is a
+            # 4.6 GB array on a 16 GB chip
+            gt = mk((sh["x"][0], sh["x"][1], sh["w"][-1], sh["x"][2]))
+
+        if not want or f"{cname}_fwd_t" in want:
+            def s_t(acc, xt, w, bias):
+                y = conv3x3_t(xt + acc.astype(xt.dtype), w, bias)
+                return red(y)
+            time_op(f"{cname}_fwd_pallas_t", s_t, fl, io_fwd, xt, w, bias)
+
+            def s_t_stats(acc, xt, w, bias):
+                y, s, ss = conv3x3_t_stats(xt + acc.astype(xt.dtype),
+                                           w, bias)
+                return red(y)
+            time_op(f"{cname}_fwd_pallas_t_stats", s_t_stats, fl, io_fwd,
+                    xt, w, bias)
+
+        if not want or f"{cname}_bwd_t" in want:
+            def s_bwd_t(acc, xt, w, bias, gt):
+                _, vjp = jax.vjp(
+                    lambda xx, ww, bb: conv3x3_t(xx, ww, bb),
+                    xt + acc.astype(xt.dtype), w, bias)
+                dx, dw, db = vjp(gt)
+                return red(dx) + red(dw) + red(db)
+            time_op(f"{cname}_bwd_pallas_t", s_bwd_t, 2 * fl,
+                    2 * nbytes(sh["x"]) + 2 * nbytes(gt.shape),
+                    xt, w, bias, gt)
+
+        # wgrad alone (the isolated fused dw+db pass — what conv1's
+        # backward pays in the real step, where dx is DCE'd) and dgrad
+        # alone (fwd kernel on flipped weights)
+        if not want or f"{cname}_wgrad_t" in want:
+            def s_wgrad_t(acc, xt, gt):
+                dwt, db = conv3x3_t_wgrad(xt + acc.astype(xt.dtype), gt)
+                return red(dwt) + red(db)
+            time_op(f"{cname}_wgrad_pallas_t", s_wgrad_t, fl,
+                    nbytes(sh["x"]) + nbytes(gt.shape), xt, gt)
+
+        if not want or f"{cname}_dgrad_t" in want:
+            wf = _flip_transpose(w)
+            zb = jnp.zeros((sh["x"][-1],), gt.dtype)
+
+            def s_dgrad_t(acc, gt, wf, zb):
+                y = conv3x3_t(gt + acc.astype(gt.dtype), wf, zb)
+                return red(y)
+            time_op(f"{cname}_dgrad_pallas_t", s_dgrad_t,
+                    fwd_flops((sh["x"][0], sh["x"][1], sh["x"][2],
+                               sh["w"][-1]), wf.shape),
+                    nbytes(gt.shape) + nbytes(sh["x"]),
+                    gt, wf, zb)
+
+        if not want or (want & t_ops):
+            del xt
+        if not want or (want & g_ops):
+            del gt
 
         # -------- dgrad alone (fwd kernel, flipped weights) --------
         if not want or f"{cname}_dgrad" in want:
@@ -176,12 +261,13 @@ def main() -> None:
             wf = _flip_transpose(w)
             zb = jnp.zeros((sh["x"][-1],), g.dtype)
 
-            def s_dgrad(acc, g=g, wf=wf, zb=zb):
+            def s_dgrad(acc, g, wf, zb):
                 y = conv3x3(g + acc.astype(g.dtype), wf, zb)
-                return y[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+                return red(y)
             time_op(f"{cname}_dgrad_pallas", s_dgrad,
                     fwd_flops(g.shape, wf.shape),
-                    nbytes(g.shape) + nbytes(sh["x"]))
+                    nbytes(g.shape) + nbytes(sh["x"]),
+                    g, wf, zb)
 
     print(json.dumps({"note": "pair tflops against the shape's MXU "
                               "ceiling and hbm_gbps against ~819 GB/s "

@@ -3,6 +3,12 @@
 # jax.devices()); when it answers, run the round-3 rerun ladder
 # sequentially. ONE chip process at a time — nothing else may touch the
 # chip while this runs (see memory: tpu-chip-discipline).
+#
+# r03 status before arming: bs=16 s2dt measured 80.36 img/s (1.07x
+# baseline, measured/images_per_sec_s2dt_b16.json); the tunnel wedged
+# before the bs=5 run. The ladder finishes the measured story: parity
+# batch, capacity, the plan race, LM dots-remat, kernel checks,
+# seq scaling.
 cd "$(dirname "$0")/.." || exit 1
 log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
 
@@ -15,24 +21,24 @@ while true; do
 done
 log "chip recovered; rerun ladder starting"
 
-log "R0 conv_micro (per-kernel diagnosis, bs=16)"
-timeout 3000 python tools/conv_micro.py --batch 16 > measured/conv_micro_r03.jsonl 2> measured/conv_micro_r03.err
+log "R0 images_per_sec bs=5 (s2dt, the reference parity batch)"
+timeout 2400 python bench.py --batch-per-device 5 --steps 15 > measured/images_per_sec_s2dt_b5.json 2> measured/images_per_sec_s2dt_b5.err
 log "R0 exit $?"
 
-log "R1 pallas (fixed f32 tol)"
-timeout 1800 python bench.py --metric pallas > measured/pallas_r03.json 2> measured/pallas_r03.err
+log "R1 capacity (s2dt: AOT says bs=16 at 11.8 GB -> headroom above 16)"
+timeout 3600 python bench.py --metric capacity > measured/capacity_r03.json 2> measured/capacity_r03.err
 log "R1 exit $?"
 
-log "R2 lm (dots remat, b16)"
-timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r03.json 2> measured/lm_dots_b16_r03.err
+log "R2 sweep (batch ladder + plan race: s2dt vs nhwc vs xla)"
+timeout 5400 python bench.py --metric sweep --steps 8 > measured/sweep_r03.json 2> measured/sweep_r03.err
 log "R2 exit $?"
 
-log "R3 capacity"
-timeout 2400 python bench.py --metric capacity > measured/capacity_r03.json 2> measured/capacity_r03.err
+log "R3 lm (dots remat, b16)"
+timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r03.json 2> measured/lm_dots_b16_r03.err
 log "R3 exit $?"
 
-log "R4 sweep"
-timeout 3600 python bench.py --metric sweep --steps 5 > measured/sweep_r03.json 2> measured/sweep_r03.err
+log "R4 pallas (now incl. transposed kernels)"
+timeout 2400 python bench.py --metric pallas > measured/pallas_r03.json 2> measured/pallas_r03.err
 log "R4 exit $?"
 
 log "R5 seq_scaling"

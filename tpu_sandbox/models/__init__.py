@@ -1,40 +1,65 @@
 from tpu_sandbox.models.convnet import ConvNet  # noqa: F401
 from tpu_sandbox.models.convnet_s2d import ConvNetS2D  # noqa: F401
+from tpu_sandbox.models.convnet_s2d_t import ConvNetS2DT  # noqa: F401
 
 
 def resolves_to_s2d(image_size, plan: str = "auto") -> bool:
     """Single home for the auto-plan rule: does this (image_size, plan)
-    request run the s2d execution plan? Callers that label or annotate
-    results by plan (bench sweep's kernel race, the degraded line's AOT
-    estimate block) must use this rather than re-deriving the rule."""
+    request run a space-to-depth execution plan (NHWC or transposed)?
+    Callers that label or annotate results by plan (bench sweep's kernel
+    race, the degraded line's AOT estimate block) must use this rather
+    than re-deriving the rule."""
     h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
     return plan != "plain" and (
-        plan == "s2d" or (plan == "auto" and h % 4 == 0 and w % 4 == 0)
+        plan in ("s2d", "s2dt") or (plan == "auto" and h % 4 == 0
+                                    and w % 4 == 0)
     )
 
 
-def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
-    """The execution-plan switch: ConvNetS2D (space-to-depth, the TPU fast
-    path — see models/convnet_s2d.py) when the plan applies, else the plain
-    ConvNet. Both are the same function (tests/test_convnet_s2d.py).
+def resolve_plan(image_size, plan: str = "auto") -> str:
+    """Concrete plan for a request: 's2dt' | 's2d' | 'plain'.
 
-    On backends where Pallas kernels COMPILE (TPU, or chipless AOT with
-    TPU_SANDBOX_FORCE_COMPILED_KERNELS=1) the s2d plan defaults to the
-    fused Pallas BN/ReLU/pool tail (2.6x less HBM traffic per image by v5e
-    AOT analysis of the compiled Mosaic kernels: 5.45 vs 14.18 GB/img at
-    bs=16; equality-tested). Elsewhere the kernels would run interpreted —
-    a large slowdown in a training loop — so the default stays unfused.
-    Pass fused_tail explicitly to override either way (accepted and
-    ignored by the plain plan)."""
-    h, w = (image_size, image_size) if isinstance(image_size, int) else image_size
+    'auto' picks the transposed plan (models/convnet_s2d_t.py — the
+    measured-fastest execution, always-Pallas) wherever the kernels
+    COMPILE (TPU, or chipless AOT via TPU_SANDBOX_FORCE_COMPILED_KERNELS),
+    the NHWC s2d plan where they would run interpreted (CPU tests), and
+    the plain ConvNet when the image is not 4-divisible."""
+    if not resolves_to_s2d(image_size, plan):
+        return "plain"
+    if plan in ("s2d", "s2dt"):
+        return plan
+    from tpu_sandbox.ops.pallas_common import default_interpret
+
+    return "s2dt" if not default_interpret(None) else "s2d"
+
+
+def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
+    """The execution-plan switch. Three plans, one function
+    (tests/test_convnet_s2d.py, tests/test_convnet_s2d_t.py):
+
+    - 's2dt' (TPU default): transposed space-to-depth, [N,H,C,W] Pallas
+      conv + fused-tail kernels throughout — the round-3 measured-fastest
+      plan (see models/convnet_s2d_t.py docstring for the numbers);
+    - 's2d': NHWC space-to-depth; Pallas kernels gated by fused_tail /
+      fused_conv (defaulting on where kernels compile);
+    - 'plain': the direct NHWC ConvNet (the reference-shaped execution).
+
+    fused_tail/fused_conv kwargs are accepted for every plan and applied
+    where they mean something (the transposed plan has no unfused conv;
+    the plain plan ignores both)."""
+    resolved = resolve_plan(image_size, plan)
     fused = kwargs.pop("fused_tail", None)
     fused_conv = kwargs.pop("fused_conv", None)
-    if resolves_to_s2d(image_size, plan):
-        if fused is None or fused_conv is None:
-            from tpu_sandbox.ops.pallas_common import default_interpret
+    if resolved == "plain":
+        return ConvNet(**kwargs)
+    from tpu_sandbox.ops.pallas_common import default_interpret
 
-            compiled = not default_interpret(None)
-            fused = compiled if fused is None else fused
-            fused_conv = compiled if fused_conv is None else fused_conv
-        return ConvNetS2D(fused_tail=fused, fused_conv=fused_conv, **kwargs)
-    return ConvNet(**kwargs)
+    compiled = not default_interpret(None)
+    if resolved == "s2dt":
+        return ConvNetS2DT(fused_tail=compiled if fused is None else fused,
+                           **kwargs)
+    return ConvNetS2D(
+        fused_tail=compiled if fused is None else fused,
+        fused_conv=compiled if fused_conv is None else fused_conv,
+        **kwargs,
+    )

@@ -1,0 +1,205 @@
+"""ConvNetS2DT: the space-to-depth ConvNet in TRANSPOSED layout
+[N, H, C, W] — round 3's production execution plan.
+
+Same function as models.convnet.ConvNet and models.convnet_s2d.ConvNetS2D
+(reference mnist_onegpu.py:11-31), exactly — forward, gradients, and
+batch-stats updates agree to float tolerance (tests/test_convnet_s2d_t.py)
+— and the parameter/batch_stats tree is bit-compatible with both, so
+checkpoints, TrainState, and every engine accept any of the three.
+
+Why a third plan: on-chip micro-benchmarks (measured/conv_micro_r03.jsonl)
+showed the NHWC s2d Pallas convs running at 19-27 TF/s — below the XLA
+convs they replaced — because with channels on the 128-lane minor dim the
+[W, 9C] im2col tile build wastes 7/8 of every VPU op at C=16 and the
+operands are lane-padded up to 8x in HBM. Putting channels on SUBLANES
+and W on lanes (ops/pallas_conv_t.py) made the tile build tile-aligned
+sublane stacking: conv1 fwd 24.6 -> 15.3 ms, conv1 fwd+BN-stats
+29.1 -> 15.3 ms (the stats fusion became free), conv2 bwd 57.6 -> 41.1 ms
+at bs=16, with the fused tail pair (ops/pallas_bn_tail_t.py) keeping the
+BN/ReLU/pool chain at one HBM pass per direction.
+
+Layout plumbing (the only places the transpose exists):
+- input: ``space_to_depth_t`` emits [N, H/4, 16, W/4] straight from the
+  [N, H, W] image — one device transpose of the raw input;
+- output: pool2's [N, H/4, f2, W/4] is transposed back before flatten so
+  the fc sees the reference's (h, w, c) feature order — fc weights stay
+  interchangeable with ConvNet's.
+Channel indexing within C is identical to ConvNetS2D (co minor, (a,b)
+block-position major), so BN grouping, pooling pairs, and the kernel
+scatter are shared unchanged.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_sandbox.models.convnet_s2d import scatter_kernel
+
+
+def space_to_depth_t(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    """[N,H,W] -> [N, H/r, r*r, W/r] (channel index a*r+b, channels on
+    the sublane dim)."""
+    n, h, w = x.shape
+    x = x.reshape(n, h // r, r, w // r, r)
+    return x.transpose(0, 1, 2, 4, 3).reshape(n, h // r, r * r, w // r)
+
+
+def block_max_pool_t(y: jnp.ndarray, blk: int, co: int) -> jnp.ndarray:
+    """2x2/2 max-pool inside the channel (sublane) dim: y
+    [..., blk*blk*co, W] with ordering (a*blk+b)*co+c; pool pairs are the
+    LOW bits of (a, b). Returns [..., (blk//2)**2*co, W]. Slice/maximum
+    form for the same layout reason as block_max_pool."""
+    *lead, c, w = y.shape
+    assert c == blk * blk * co, (c, blk, co)
+    y = y.reshape(*lead, blk // 2, 2, blk // 2, 2, co, w)
+    m = jnp.maximum(
+        jnp.maximum(y[..., :, 0, :, 0, :, :], y[..., :, 0, :, 1, :, :]),
+        jnp.maximum(y[..., :, 1, :, 0, :, :], y[..., :, 1, :, 1, :, :]),
+    )
+    return m.reshape(*lead, (blk // 2) ** 2 * co, w)
+
+
+class _ConvT(nn.Module):
+    """Same canonical [5,5,ci,co] kernel + bias variables as ConvNet /
+    ConvNetS2D, applied s2d-scattered in transposed layout via the
+    Pallas kernel (ops/pallas_conv_t.py)."""
+
+    shape: tuple[int, ...]
+    r: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, want_stats: bool = False):
+        from tpu_sandbox.ops.pallas_conv_t import conv3x3_t, conv3x3_t_stats
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
+        )
+        wg = scatter_kernel(kernel.astype(self.dtype), self.r)
+        reps = wg.shape[-1] // self.shape[-1]
+        bias_g = jnp.tile(bias.astype(self.dtype), reps)
+        if want_stats:
+            y, s, ss = conv3x3_t_stats(x, wg, bias_g)
+            return y, (s, ss)
+        return conv3x3_t(x, wg, bias_g)
+
+
+class _GroupedBNT(nn.Module):
+    """_GroupedBN semantics (models/convnet_s2d.py) over the transposed
+    layout [..., g*co, W]; identical variable names/shapes."""
+
+    features: int  # co
+    dtype: jnp.dtype
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    def setup(self):
+        co = self.features
+        self.scale = self.param(
+            "scale", nn.initializers.ones, (co,), jnp.float32
+        )
+        self.offset = self.param(
+            "bias", nn.initializers.zeros, (co,), jnp.float32
+        )
+        self.ra_mean = self.variable(
+            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), (co,)
+        )
+        self.ra_var = self.variable(
+            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), (co,)
+        )
+
+    def _update_running(self, mu, var):
+        if not self.is_initializing():
+            m = self.momentum
+            self.ra_mean.value = m * self.ra_mean.value + (1 - m) * mu
+            self.ra_var.value = m * self.ra_var.value + (1 - m) * var
+
+    def __call__(self, y, train: bool):
+        co = self.features
+        *lead, c, w = y.shape
+        yg = y.reshape(*lead, c // co, co, w)
+        if train:
+            yf = yg.astype(jnp.float32)
+            red = tuple(i for i in range(yf.ndim) if i != yf.ndim - 2)
+            mu = jnp.mean(yf, axis=red)
+            mu2 = jnp.mean(jnp.square(yf), axis=red)
+            var = jnp.maximum(0.0, mu2 - jnp.square(mu))
+            self._update_running(mu, var)
+        else:
+            mu, var = self.ra_mean.value, self.ra_var.value
+        out = (yg.astype(jnp.float32) - mu[:, None]) * (
+            jax.lax.rsqrt(var + self.epsilon) * self.scale
+        )[:, None] + self.offset[:, None]
+        return out.astype(self.dtype).reshape(*lead, c, w)
+
+    def fused(self, y, blk: int, ysums=None):
+        from tpu_sandbox.ops.pallas_bn_tail_t import fused_bn_relu_pool_t
+
+        out, mu, var = fused_bn_relu_pool_t(
+            y, self.scale, self.offset, self.features, blk, self.epsilon,
+            None, ysums,
+        )
+        self._update_running(mu, var)
+        return out
+
+
+class ConvNetS2DT(nn.Module):
+    """Drop-in ConvNet with the transposed space-to-depth execution plan.
+
+    Always runs the Pallas conv kernels; ``fused_tail=True`` (the TPU
+    default via ``pick_convnet``) additionally fuses each BN/ReLU/pool
+    tail and rides the conv kernels' fused BN statistics. Requires H, W
+    divisible by 4 and one input channel (the reference's 3000x3000
+    MNIST qualifies); other configs use models.convnet.ConvNet.
+    """
+
+    num_classes: int = 10
+    features: tuple[int, ...] = (16, 32)
+    dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
+    use_bn: bool = True
+    fused_tail: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        """x: [N,H,W,1] NHWC or [N,H,W]. Returns logits [N, num_classes]."""
+        assert len(self.features) == 2, "s2d plan is the 2-block parity CNN"
+        f1, f2 = self.features
+        if x.ndim == 4:
+            assert x.shape[-1] == 1, "s2d plan is for the 1-channel CNN"
+            x = x[..., 0]
+        n, h, w = x.shape
+        assert h % 4 == 0 and w % 4 == 0, (h, w)
+
+        fuse_stats = self.fused_tail and self.use_bn and train
+
+        x = space_to_depth_t(x, 4).astype(self.dtype)    # [N,H/4,16,W/4]
+        y = _ConvT((5, 5, 1, f1), r=4, dtype=self.dtype,
+                   name="conv1")(x, fuse_stats)
+        y, ysums = y if fuse_stats else (y, None)
+        y = self._tail(y, f1, 4, "bn1", train, ysums)    # [N,H/4,4*f1,W/4]
+
+        y = _ConvT((5, 5, f1, f2), r=2, dtype=self.dtype,
+                   name="conv2")(y, fuse_stats)
+        y, ysums = y if fuse_stats else (y, None)
+        y = self._tail(y, f2, 2, "bn2", train, ysums)    # [N,H/4,f2,W/4]
+
+        # back to the reference's (h, w, c) feature order for the fc
+        y = y.transpose(0, 1, 3, 2).reshape(n, -1)
+        y = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(y)
+        return jnp.asarray(y, jnp.float32)
+
+    def _tail(self, y, co: int, blk: int, name: str, train: bool,
+              ysums=None):
+        """BN + ReLU + 2x2 block pool — fused Pallas pair when enabled."""
+        if self.use_bn and self.fused_tail and train:
+            return _GroupedBNT(co, self.dtype, name=name).fused(
+                y, blk, ysums)
+        if self.use_bn:
+            y = _GroupedBNT(co, self.dtype, name=name)(y, train)
+        y = nn.relu(y)
+        return block_max_pool_t(y, blk, co)
