@@ -84,6 +84,8 @@ def classify(opcode: str, line: str, out_bytes: int) -> str:
     if m:
         path = m.group(1)
         bwd = "transpose(" in path
+        if "fused_input_stage" in path:  # jvp(Model.fused_input_stage)/...
+            return f"input-stage-{'bwd' if bwd else 'fwd'}"
         for tag in ("conv1", "conv2", "fc", "_resize", "bn1", "bn2"):
             if f"/{tag}/" in path or path.startswith(f"jvp(jit({tag}))"):
                 if tag.startswith("conv") and bwd:
@@ -101,7 +103,8 @@ def classify(opcode: str, line: str, out_bytes: int) -> str:
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--plan", choices=["s2d", "plain"], default="s2d")
+    p.add_argument("--plan", choices=["s2dt", "s2d", "plain"],
+                   default="s2dt")
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--image-size", type=int, default=3000)
     p.add_argument("--top", type=int, default=25)

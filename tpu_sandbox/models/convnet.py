@@ -59,7 +59,13 @@ class ConvNet(nn.Module):
                 )(x)
             x = nn.relu(x)
             x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
-        x = x.reshape(x.shape[0], -1)
+        # Canonical fc row order is (h, c, w) — the transposed production
+        # plan's native feature layout, so its fc contraction runs with
+        # ZERO relayout copies (models/convnet_s2d_t.py::_DenseT); the
+        # NHWC plans pay this one small transpose instead. The torch
+        # reference flattens NCHW as (c, h, w) — utils/parity.py
+        # re-blocks between the conventions either way.
+        x = x.transpose(0, 1, 3, 2).reshape(x.shape[0], -1)
         # Flax sizes the kernel from x at init time — LazyLinear semantics.
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
         return jnp.asarray(x, jnp.float32)  # logits/loss in fp32 always

@@ -35,7 +35,8 @@ Channel orderings keep co minor so BN/bias are grouped reshapes:
   pool1 out  c = (a1*2+b1)*16 + co (2x2 max over the low bits of a,b)
   conv2 out  c = (a2*2+b2)*32 + co
   pool2 out  plain [N,750,750,32] — bit-identical memory order to
-             ConvNet's pool2 output, so flatten + fc need no permutation.
+             ConvNet's pool2 output; both transpose to the canonical
+             (h, c, w) fc row order before flatten (models/convnet.py).
 
 Kernel scatter: an original tap (dx,dy) seen from an output pixel at
 in-block position (a,b) reads the input block at offset P=(a+dx-2)//r,
@@ -267,7 +268,8 @@ class ConvNetS2D(nn.Module):
         y, ysums = y if fuse_stats else (y, None)
         y = self._tail(y, f2, 2, "bn2", train, ysums)     # [N,H/4,W/4,f2]
 
-        y = y.reshape(n, -1)
+        # canonical (h, c, w) fc row order — see models/convnet.py
+        y = y.transpose(0, 1, 3, 2).reshape(n, -1)
         y = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(y)
         return jnp.asarray(y, jnp.float32)
 

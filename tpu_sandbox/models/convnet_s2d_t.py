@@ -21,9 +21,10 @@ BN/ReLU/pool chain at one HBM pass per direction.
 Layout plumbing (the only places the transpose exists):
 - input: ``space_to_depth_t`` emits [N, H/4, 16, W/4] straight from the
   [N, H, W] image — one device transpose of the raw input;
-- output: pool2's [N, H/4, f2, W/4] is transposed back before flatten so
-  the fc sees the reference's (h, w, c) feature order — fc weights stay
-  interchangeable with ConvNet's.
+- output: pool2's [N, H/4, f2, W/4] feeds the fc directly — the
+  framework-canonical fc row order is (h, c, w), this plan's native
+  feature order (models/convnet.py), so no transpose exists here at all
+  and fc weights stay interchangeable with ConvNet's.
 Channel indexing within C is identical to ConvNetS2D (co minor, (a,b)
 block-position major), so BN grouping, pooling pairs, and the kernel
 scatter are shared unchanged.
@@ -31,11 +32,37 @@ scatter are shared unchanged.
 
 from __future__ import annotations
 
+import functools
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_sandbox.models.convnet_s2d import scatter_kernel
+
+
+@functools.lru_cache(maxsize=8)
+def resize_weights(src: int, dst: int) -> np.ndarray:
+    """[dst, src] bilinear interpolation matrix with EXACTLY
+    jax.image.resize's weights (train.resize_on_device's method): resize
+    is linear and separable, so resizing the identity yields its weight
+    matrix. Host-cached f32 constant (a few hundred KB at 28->3000 —
+    safely under the remote-compile body limit that bars closing over
+    full-size images)."""
+    with jax.ensure_compile_time_eval():  # concrete even mid-trace
+        eye = jnp.eye(src, dtype=jnp.float32)
+        w = jax.image.resize(eye, (dst, src), method="bilinear")
+        return np.asarray(jax.device_get(w))
+
+
+def _as_nhw(x: jnp.ndarray) -> jnp.ndarray:
+    """[N,H,W,1] or [N,H,W] -> [N,H,W] (shared by __call__ and
+    fused_input_stage)."""
+    if x.ndim == 4:
+        assert x.shape[-1] == 1, "s2d plan is for the 1-channel CNN"
+        x = x[..., 0]
+    return x
 
 
 def space_to_depth_t(x: jnp.ndarray, r: int) -> jnp.ndarray:
@@ -148,6 +175,39 @@ class _GroupedBNT(nn.Module):
         return out
 
 
+class _DenseT(nn.Module):
+    """nn.Dense over the transposed feature map WITHOUT materializing the
+    (h, w, c) activation transpose. The kernel variable stays
+    [h*w*c, k] with rows flattened in canonical (h, c, w) order — the
+    parameter tree is bit-identical to ConvNet's fc: same init path (so
+    the same values under the same key), rows in the framework-canonical
+    (h, c, w) order that all three plans share — see models/convnet.py
+    (the torch reference's own NCHW flatten is (c, h, w); utils/parity.py
+    re-blocks between the conventions). The contraction reads y in
+    its native [N, h, c, w] layout against the kernel viewed as
+    [h, c, w, k]: contraction order aligned on both sides, so neither
+    the 2.3 GB activation nor the 180M-param weight is ever relayouted
+    (the r03 step spent ~40 ms/step at bs=16 on exactly those copies —
+    measured/hlo_cycles_s2dt_b16_r04.json)."""
+
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, y: jnp.ndarray) -> jnp.ndarray:
+        n, h, c, w = y.shape
+        kernel = self.param(
+            "kernel", nn.linear.default_kernel_init,
+            (h * w * c, self.features), jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        k4 = kernel.astype(self.dtype).reshape(h, c, w, self.features)
+        out = jnp.einsum("nhcw,hcwk->nk", y, k4)
+        return out + bias.astype(self.dtype)
+
+
 class ConvNetS2DT(nn.Module):
     """Drop-in ConvNet with the transposed space-to-depth execution plan.
 
@@ -164,20 +224,53 @@ class ConvNetS2DT(nn.Module):
     use_bn: bool = True
     fused_tail: bool = False
 
+    def fused_input_stage(self, images: jnp.ndarray,
+                          image_size: tuple[int, int]) -> jnp.ndarray:
+        """Bilinear resize (exactly train.resize_on_device's weights, see
+        ``resize_weights``) fused with ``space_to_depth_t``: two small
+        contractions against the interpolation matrices emit
+        [N, H/4, 16, W/4] straight from the raw [N, h0, w0(, 1)] batch.
+        The full-size [N, H, W] image never materializes — in the r03
+        step that intermediate cost two whole-image relayout copies
+        (~55 ms/step at bs=16, the largest single residue in the 199 ms
+        step; measured/hlo_cycles_s2dt_b16_r04.json). Feed the result to
+        ``__call__``, which detects the pre-s2d shape."""
+        H, W = image_size
+        assert H % 4 == 0 and W % 4 == 0, (H, W)
+        images = _as_nhw(images)
+        n, h0, w0 = images.shape
+        ah4 = jnp.asarray(resize_weights(h0, H)).reshape(H // 4, 4, h0)
+        aw4 = jnp.asarray(resize_weights(w0, W)).reshape(W // 4, 4, w0)
+        x = images.astype(jnp.float32)
+        u = jnp.einsum("nij,wbj->nibw", x, aw4)          # [N, h0, 4, W/4]
+        v = jnp.einsum("hai,nibw->nhabw", ah4, u)
+        return v.reshape(n, H // 4, 16, W // 4).astype(self.dtype)
+
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        """x: [N,H,W,1] NHWC or [N,H,W]. Returns logits [N, num_classes]."""
+        """x: [N,H,W,1] NHWC, [N,H,W], or a pre-s2d [N,H/4,16,W/4] from
+        ``fused_input_stage`` (distinguished by its non-1 trailing dim).
+        Returns logits [N, num_classes]."""
         assert len(self.features) == 2, "s2d plan is the 2-block parity CNN"
         f1, f2 = self.features
-        if x.ndim == 4:
-            assert x.shape[-1] == 1, "s2d plan is for the 1-channel CNN"
-            x = x[..., 0]
-        n, h, w = x.shape
-        assert h % 4 == 0 and w % 4 == 0, (h, w)
+
+        if x.ndim == 4 and x.shape[-1] != 1:             # pre-s2d input
+            # pre-s2d tensors come only from fused_input_stage
+            if x.shape[2] != 16:
+                raise ValueError(
+                    "expected [N,H,W,1]/[N,H,W] (the s2d plan is the "
+                    "1-channel CNN) or a fused_input_stage output "
+                    f"[N,H/4,16,W/4]; got {x.shape}"
+                )
+            x = x.astype(self.dtype)
+            n = x.shape[0]
+        else:
+            x = _as_nhw(x)
+            n, h, w = x.shape
+            assert h % 4 == 0 and w % 4 == 0, (h, w)
+            x = space_to_depth_t(x, 4).astype(self.dtype)  # [N,H/4,16,W/4]
 
         fuse_stats = self.fused_tail and self.use_bn and train
-
-        x = space_to_depth_t(x, 4).astype(self.dtype)    # [N,H/4,16,W/4]
         y = _ConvT((5, 5, 1, f1), r=4, dtype=self.dtype,
                    name="conv1")(x, fuse_stats)
         y, ysums = y if fuse_stats else (y, None)
@@ -188,9 +281,9 @@ class ConvNetS2DT(nn.Module):
         y, ysums = y if fuse_stats else (y, None)
         y = self._tail(y, f2, 2, "bn2", train, ysums)    # [N,H/4,f2,W/4]
 
-        # back to the reference's (h, w, c) feature order for the fc
-        y = y.transpose(0, 1, 3, 2).reshape(n, -1)
-        y = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(y)
+        # fc contracts the transposed map in place; the kernel variable
+        # keeps the canonical (h, c, w) row order all plans share (_DenseT)
+        y = _DenseT(self.num_classes, self.dtype, name="fc")(y)
         return jnp.asarray(y, jnp.float32)
 
     def _tail(self, y, co: int, blk: int, name: str, train: bool,

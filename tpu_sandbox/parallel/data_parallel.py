@@ -204,8 +204,8 @@ class DataParallel:
             # Per-rank block: images [B/size, ...]; BN stats [1, ...] -> local.
             local_stats = jax.tree.map(lambda x: x[0], state.batch_stats)
             if image_size is not None:
-                from tpu_sandbox.train import resize_on_device
-                images = resize_on_device(images, image_size)
+                from tpu_sandbox.train import prepare_inputs
+                images = prepare_inputs(model, images, image_size)
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, local_stats, images, labels
             )

@@ -290,10 +290,8 @@ class PjitEngine:
 
         def step(state: TrainState, images, labels):
             if image_size is not None and self.task == "image":
-                n, _, _, c = images.shape
-                images = jax.image.resize(
-                    images, (n, *image_size, c), method="bilinear"
-                )
+                from tpu_sandbox.train import prepare_inputs
+                images = prepare_inputs(model, images, image_size)
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, state.batch_stats, images, labels
             )

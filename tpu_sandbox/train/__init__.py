@@ -2,5 +2,6 @@ from tpu_sandbox.train.state import TrainState  # noqa: F401
 from tpu_sandbox.train.trainer import (  # noqa: F401
     Trainer,
     make_train_step,
+    prepare_inputs,
     resize_on_device,
 )

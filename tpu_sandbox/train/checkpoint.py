@@ -21,6 +21,58 @@ import orbax.checkpoint as ocp
 from tpu_sandbox.train.state import TrainState
 
 
+#: Parameter-layout generation stamped into every checkpoint directory.
+#: "hcw" = the canonical (h, c, w) fc row order (models/convnet.py,
+#: round 4). Checkpoints written before the stamp existed hold fc rows
+#: in (h, w, c) order — same shapes, silently permuted values — so
+#: restore refuses them loudly instead of resuming into garbage logits.
+_LAYOUT = "fc-row-order=hcw"
+_LAYOUT_FILE = "LAYOUT"
+
+
+def _has_steps(directory: Path) -> bool:
+    """Any orbax step directory (numeric child) present?"""
+    return directory.is_dir() and any(
+        p.name.isdigit() for p in directory.iterdir() if p.is_dir()
+    )
+
+
+def _layout_error(directory: Path, found: str) -> ValueError:
+    return ValueError(
+        f"checkpoint layout mismatch under {directory}: expected "
+        f"'{_LAYOUT}', found '{found}'. Checkpoints from before the "
+        "canonical (h, c, w) fc row order hold the same-shaped fc "
+        "kernel with permuted rows; restoring would silently corrupt "
+        "the model. Re-save from the original code or re-permute "
+        "fc/kernel rows (h,w,c)->(h,c,w)."
+    )
+
+
+def _stamp_layout(directory: Path) -> None:
+    f = directory / _LAYOUT_FILE
+    if f.exists():
+        _check_layout(directory)
+    elif _has_steps(directory):
+        # refusing to stamp an unstamped directory that already holds
+        # steps: stamping would launder its pre-canonical checkpoints
+        # past the very guard the stamp implements
+        raise _layout_error(directory, "<missing, with existing steps>")
+    else:
+        directory.mkdir(parents=True, exist_ok=True)
+        f.write_text(_LAYOUT + "\n")
+
+
+def _check_layout(directory: Path) -> None:
+    f = directory / _LAYOUT_FILE
+    if not f.exists():
+        if _has_steps(directory):
+            raise _layout_error(directory, "<missing>")
+        return  # empty/absent dir: let orbax report not-found clearly
+    found = f.read_text().strip()
+    if found != _LAYOUT:
+        raise _layout_error(directory, found)
+
+
 def _manager(directory: str | os.PathLike, create: bool = True) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         Path(directory).absolute(),
@@ -31,6 +83,7 @@ def _manager(directory: str | os.PathLike, create: bool = True) -> ocp.Checkpoin
 def save(directory: str | os.PathLike, state: TrainState, step: int | None = None) -> int:
     """Write a checkpoint; returns the step it was saved under."""
     step = int(state.step) if step is None else step
+    _stamp_layout(Path(directory).absolute())
     with _manager(directory) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(state))
         mgr.wait_until_finished()
@@ -48,6 +101,7 @@ class AsyncSaver:
     (or exiting the context) waits for outstanding writes."""
 
     def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        _stamp_layout(Path(directory).absolute())
         self._mgr = ocp.CheckpointManager(
             Path(directory).absolute(),
             options=ocp.CheckpointManagerOptions(
@@ -77,6 +131,7 @@ def restore(
     directory: str | os.PathLike, template: TrainState, step: int | None = None
 ) -> TrainState:
     """Restore into the template's structure (and shardings, if sharded)."""
+    _check_layout(Path(directory).absolute())
     with _manager(directory, create=False) as mgr:
         if step is None:
             step = mgr.latest_step()

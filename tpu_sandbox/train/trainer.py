@@ -48,6 +48,20 @@ def resize_on_device(images, image_size):
     return jax.image.resize(images, (n, *image_size, c), method="bilinear")
 
 
+def prepare_inputs(model, images, image_size):
+    """The model-plan-aware input stage: models exposing
+    ``fused_input_stage`` (ConvNetS2DT) consume the raw small batch
+    directly — resize + space-to-depth in two small contractions, no
+    full-size [N,H,W] intermediate — and their ``__call__`` detects the
+    pre-s2d shape. Every other model gets the plain on-device resize.
+    Single home: the trainer and both parallel engines route through
+    here."""
+    stage = getattr(model, "fused_input_stage", None)
+    if stage is not None:
+        return stage(images, image_size)
+    return resize_on_device(images, image_size)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -78,7 +92,7 @@ def make_train_step(
 
     def loss_fn(params, batch_stats, images, labels):
         if image_size is not None:
-            images = resize_on_device(images, image_size)
+            images = prepare_inputs(model, images, image_size)
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
@@ -150,7 +164,7 @@ def make_eval_step(model, *, image_size: tuple[int, int] | None = None) -> Calla
     @jax.jit
     def eval_step(state: TrainState, images: jax.Array, labels: jax.Array):
         if image_size is not None:
-            images = resize_on_device(images, image_size)
+            images = prepare_inputs(model, images, image_size)
         logits = model.apply(state.variables(), images, train=False)
         loss = cross_entropy_loss(logits, labels)
         correct = jnp.sum(jnp.argmax(logits, -1) == labels)

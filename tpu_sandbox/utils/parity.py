@@ -8,9 +8,10 @@ from bit-identical init and can be trained on identical batches
 (parity_run.py at the repo root records the experiment; tests/test_convnet.py
 asserts it at short horizon).
 
-Layout conversions: flax conv kernels are HWIO -> torch OIHW; the flax
-flatten is NHWC-ordered while torch flattens NCHW, so the fc weight is
-re-blocked accordingly.
+Layout conversions: flax conv kernels are HWIO -> torch OIHW; the
+framework's canonical fc row order is (h, c, w) (models/convnet.py)
+while torch flattens NCHW as (c, h, w), so the fc weight is re-blocked
+accordingly.
 """
 
 from __future__ import annotations
@@ -52,8 +53,10 @@ def torch_twin(torch, params, hw: int):
             layer[1].bias.copy_(torch.from_numpy(
                 np.asarray(params[f"bn{i}"]["bias"]).copy()))
         fck = np.asarray(params["fc"]["kernel"])
-        fck_hwc = (fck.reshape(hw, hw, 32, 10)
-                   .transpose(2, 0, 1, 3).reshape(32 * hw * hw, 10))
-        tm.fc.weight.copy_(torch.from_numpy(fck_hwc.T.copy()))
+        # ours: canonical (h, c, w) rows (models/convnet.py) -> torch:
+        # NCHW flatten = (c, h, w) rows
+        fck_chw = (fck.reshape(hw, 32, hw, 10)
+                   .transpose(1, 0, 2, 3).reshape(32 * hw * hw, 10))
+        tm.fc.weight.copy_(torch.from_numpy(fck_chw.T.copy()))
         tm.fc.bias.copy_(torch.from_numpy(np.asarray(params["fc"]["bias"]).copy()))
     return tm
