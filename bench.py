@@ -212,16 +212,32 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
     util = mfu_check(flops_per_step, sec_per_step if timing_ok else 1.0,
                      str(devices[0].device_kind), n_devices=n_dev)
 
-    # XLA's own FLOP count for the compiled step, when the backend exposes it
-    # — an independent cross-check on the analytic model.
-    flops_xla = None
+    # XLA's own FLOP count for the compiled step, when the backend exposes
+    # it — an independent cross-check on the analytic model. Under the
+    # Pallas plans XLA cannot see into the custom calls (VERDICT r03
+    # weak-7: 26.5 GF reported vs thousands executed), so the custom
+    # calls' analytic EXECUTED flops are counted from the optimized HLO
+    # and composed; `flops_xla_partial` marks lines where that applies.
+    flops_xla = flops_xla_composed = custom_flops = None
     try:
         im, lb = staged[0]
-        cost = dp._jitted.lower(state, im, lb).compile().cost_analysis()
+        compiled = dp._jitted.lower(state, im, lb).compile()
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         if cost and "flops" in cost:
             flops_xla = float(cost["flops"])
+        from tpu_sandbox.utils.flops import s2d_custom_call_flops
+        custom = s2d_custom_call_flops(compiled.as_text(), global_batch,
+                                       image_size)
+        if custom["custom_calls_counted"] and flops_xla is not None:
+            custom_flops = custom
+            if custom.get("unmatched_pallas_calls"):
+                # a kernel the analytic table doesn't know: the composed
+                # number would silently undercount — don't publish it
+                flops_xla_composed = None
+            else:
+                flops_xla_composed = flops_xla + custom["total"]
     except Exception:
         pass
 
@@ -247,6 +263,9 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
         "sec_per_step_block_until_ready": bur_per_step,
         "flops_per_step_model": flops_per_step,
         "flops_per_step_xla": flops_xla,
+        "flops_xla_partial": custom_flops is not None,
+        "flops_per_step_xla_composed": flops_xla_composed,
+        "flops_custom_calls_analytic": custom_flops,
         "achieved_tflops": round(util["achieved_tflops"], 2),
         "peak_tflops_bf16": util["peak_tflops_bf16"],
         "mfu": round(util["mfu"], 4) if util["mfu"] is not None else None,
@@ -552,7 +571,7 @@ def bench_seq_scaling(force_cpu: bool, seq_lens=None, devices_wanted: int = 4,
         make_ulysses_attention,
     )
     from tpu_sandbox.runtime.mesh import make_mesh
-    from tpu_sandbox.utils.profiling import measure_per_step
+    from tpu_sandbox.utils.profiling import measure_per_step_repeated
 
     n_dev = jax.device_count()
     mesh = make_mesh({"sp": n_dev})
@@ -584,11 +603,12 @@ def bench_seq_scaling(force_cpu: bool, seq_lens=None, devices_wanted: int = 4,
                         x = fwdbwd(x).astype(jnp.bfloat16)
                     return x
 
-                t = measure_per_step(run, 2)
+                t = measure_per_step_repeated(run, 2)
                 # noise-negative differentials are not published (see
                 # BASELINE.md "the r01 anomaly"); record why instead
                 if t["sec_per_step"] > 0:
                     row[name + "_sec"] = t["sec_per_step"]
+                    row[name + "_spread_frac"] = t["spread_frac"]
                 else:
                     row[name + "_sec"] = None
                     row[name + "_error"] = (
@@ -910,7 +930,10 @@ def bench_pallas(force_cpu: bool) -> dict:
                for _ in range(3))
     fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=interpret))
     host_sync(fa(q, k, v))
-    timing = measure_per_step(lambda n: _chain_attn(fa, q, k, v, n), iters)
+    from tpu_sandbox.utils.profiling import measure_per_step_repeated
+    timing = measure_per_step_repeated(
+        lambda n: _chain_attn(fa, q, k, v, n), iters,
+        repeats=1 if interpret else 3)
     # causal attention: ~2 * 2 * b*h*s^2*d / 2 FLOPs (QK^T + PV, causal half)
     flops = 2 * 2 * b * h * s * s * d / 2
     tflops = flops / timing["sec_per_step"] / 1e12
@@ -925,6 +948,8 @@ def bench_pallas(force_cpu: bool) -> dict:
         "max_abs_errors": {k: round(v, 6) for k, v in checks.items()},
         "sec_per_call": timing["sec_per_step"],
         "timing_method": timing["timing_method"],
+        "sec_per_call_samples": timing.get("sec_per_step_samples"),
+        "spread_frac": timing.get("spread_frac"),
     }
 
 

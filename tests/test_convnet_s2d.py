@@ -38,7 +38,23 @@ def test_pick_convnet_plan_switch():
     assert type(pick_convnet(3001)).__name__ == "ConvNet"  # not 4-divisible
     assert type(pick_convnet((128, 64))).__name__ == "ConvNetS2D"
     assert type(pick_convnet(3000, plan="s2dt")).__name__ == "ConvNetS2DT"
-    assert resolve_plan(3000) == "s2d"          # CPU test backend
+    from tpu_sandbox.ops.pallas_common import default_interpret
+    # backend-dependent: interpret mode (CPU tests) -> NHWC s2d; compiled
+    # (TPU / forced) -> transposed (ADVICE r03)
+    assert resolve_plan(3000) == ("s2d" if default_interpret(None)
+                                  else "s2dt")
+    # and BOTH branches deterministically, via the force-compile override
+    # (a regression hardcoding 's2d' must fail off-chip too)
+    import os
+    from unittest import mock
+
+    with mock.patch.dict(os.environ,
+                         {"TPU_SANDBOX_FORCE_COMPILED_KERNELS": "1"}):
+        assert resolve_plan(3000) == "s2dt"
+    # fused_conv=False must disable the Pallas convs even where 'auto'
+    # resolves to the always-Pallas transposed plan
+    assert type(pick_convnet(3000, plan="s2dt",
+                             fused_conv=False)).__name__ == "ConvNetS2D"
     assert resolve_plan(3000, "s2dt") == "s2dt"
     assert resolve_plan(3001) == "plain"
 

@@ -98,3 +98,31 @@ def test_convnet_flops_dataclass_is_frozen():
     assert isinstance(f, ConvNetFlops)
     with pytest.raises(Exception):
         f.conv1 = 0.0
+
+
+def test_s2d_custom_call_flops_counts_pallas_calls_only():
+    """VERDICT r03 next-8: the composed FLOP cross-check counts Pallas
+    custom calls by kernel class from optimized HLO and must IGNORE plain
+    XLA gathers/scatters under the same module paths."""
+    from tpu_sandbox.utils.flops import s2d_custom_call_flops
+
+    hlo = "\n".join([
+        '  %conv1.2 = bf16[1] custom-call(%a), metadata={op_name='
+        '"jit(s)/jvp(M)/conv1/pallas_call"}',
+        '  %conv2.4 = bf16[1] custom-call(%a), metadata={op_name='
+        '"jit(s)/transpose(jvp(M))/conv2/pallas_call"}',
+        '  %bn1.fused.3 = bf16[1] custom-call(%a), metadata={op_name='
+        '"jit(s)/jvp(M)/M._tail/bn1.fused/pallas_call"}',
+        # must NOT count: an XLA gather under the conv1 path
+        '  %gather.8 = bf16[1] gather(%a), metadata={op_name='
+        '"jit(s)/jvp(M)/conv1/gather"}',
+        # must NOT count: a non-pallas custom call
+        '  %custom-call.5 = bf16[1] custom-call(%a), metadata={op_name='
+        '"jit(s)/jvp(jit(take_along_axis))/gather"}',
+    ])
+    c = s2d_custom_call_flops(hlo, 16, 3000)
+    base = 2.0 * 16 * 750 * 750
+    assert c["custom_calls_counted"] == 3
+    assert c["per_class"]["conv1"] == base * 9 * 16 * 256
+    assert c["per_class"]["conv2"] == base * 9 * 64 * 128
+    assert c["per_class"]["bn1.fused"] == base * 256 * 64

@@ -191,3 +191,76 @@ def test_fused_bn_tail_lowers_for_tpu(blk, co, w):
         return jnp.sum(out.astype(jnp.float32))
 
     _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, gamma, beta)
+
+
+@pytest.mark.parametrize("c,co", [(16, 256), (64, 128)])
+def test_pallas_conv_t_lowers_for_tpu(c, co):
+    """VERDICT r03 next-6: the TRANSPOSED conv kernels
+    (ops/pallas_conv_t.py) — the plan `auto` resolves to on TPU — at the
+    production widths (conv1: 16->256, conv2: 64->128, W=750), fwd + the
+    full VJP (flipped-weight dgrad + fused wgrad/dbias) and the stats
+    variant, under real Mosaic lowering."""
+    from tpu_sandbox.ops.pallas_conv_t import conv3x3_t, conv3x3_t_stats
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((1, 20, c, 750)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((3, 3, c, co)), jnp.bfloat16)
+    b = jnp.zeros((co,), jnp.bfloat16)
+
+    def loss(x, k, b):
+        return jnp.sum(conv3x3_t(x, k, b, False).astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), x, k, b)
+
+    def loss_stats(x, k, b):
+        y, s, ss = conv3x3_t_stats(x, k, b, False)
+        return jnp.sum(y.astype(jnp.float32)) + jnp.sum(s) + jnp.sum(ss)
+
+    _lower_tpu(jax.grad(loss_stats, argnums=(0, 1, 2)), x, k, b)
+
+
+@pytest.mark.parametrize("blk,co", [(4, 16), (2, 32)])
+def test_fused_bn_tail_t_lowers_for_tpu(blk, co):
+    """The transposed fused BN/ReLU/pool pair (ops/pallas_bn_tail_t.py)
+    at production channel heights (C=256, C=128) and W=750 — forward and
+    both backward kernels."""
+    from tpu_sandbox.ops.pallas_bn_tail_t import fused_bn_relu_pool_t
+
+    rng = np.random.default_rng(10)
+    c = blk * blk * co
+    y = jnp.asarray(rng.standard_normal((2, 10, c, 750)), jnp.bfloat16)
+    gamma = jnp.ones(co, jnp.float32)
+    beta = jnp.zeros(co, jnp.float32)
+
+    def loss(y, gamma, beta):
+        out, _, _ = fused_bn_relu_pool_t(y, gamma, beta, co, blk, 1e-5,
+                                         False)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, gamma, beta)
+
+
+def test_s2dt_train_step_lowers_for_tpu(monkeypatch):
+    """The INTEGRATED default-TPU-plan train step — ConvNetS2DT with
+    fused tails + conv-fused stats, the fused input stage, the in-layout
+    fc, SGD — lowered for TPU at the real 3000x3000 geometry (bs=1).
+    A lowering regression in the production plan fails HERE, not on the
+    chip (VERDICT r03 next-6 done-criterion)."""
+    import optax
+
+    from tpu_sandbox.models.convnet_s2d_t import ConvNetS2DT
+    from tpu_sandbox.train import TrainState, make_train_step
+
+    monkeypatch.setenv("TPU_SANDBOX_FORCE_COMPILED_KERNELS", "1")
+    model = ConvNetS2DT(dtype=jnp.bfloat16, fused_tail=True)
+    tx = optax.sgd(1e-4)
+    state = jax.eval_shape(
+        lambda: TrainState.create(
+            model, jax.random.key(0),
+            jnp.zeros((1, 3000, 3000, 1), jnp.bfloat16), tx))
+    step = make_train_step(model, tx, image_size=(3000, 3000),
+                           donate=False)
+    imgs = jax.ShapeDtypeStruct((1, 28, 28, 1), jnp.float32)
+    labs = jax.ShapeDtypeStruct((1,), jnp.int32)
+    jax.jit(step).trace(state, imgs, labs).lower(
+        lowering_platforms=("tpu",))

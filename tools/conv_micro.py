@@ -30,6 +30,9 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timing repeats per op; rows publish the min and "
+                        "the full sample list (run-to-run spread)")
     p.add_argument("--ops", type=str, default="")
     p.add_argument("--hw", type=int, default=750)
     p.add_argument("--force-cpu", action="store_true",
@@ -64,7 +67,7 @@ def main() -> None:
     )
     from tpu_sandbox.utils.profiling import (
         host_sync,
-        measure_per_step,
+        measure_per_step_repeated,
         trace as profiling_trace,
     )
 
@@ -101,7 +104,8 @@ def main() -> None:
                 acc = jstep(acc, *ops)
             return acc
 
-        t = measure_per_step(run_steps, args.iters)
+        t = measure_per_step_repeated(run_steps, args.iters,
+                                      repeats=args.repeats)
         spc = t["sec_per_step"]
         if args.trace:
             try:
@@ -120,6 +124,9 @@ def main() -> None:
             "flops": flops, "traffic_bytes_min": traffic_bytes,
             "device_kind": str(dev.device_kind),
             "timing_method": t["timing_method"],
+            "repeats": t.get("repeats", 1),
+            "sec_per_call_samples": t.get("sec_per_step_samples"),
+            "spread_frac": t.get("spread_frac"),
         }
         if spc <= 0:
             # same rule as bench.py: a non-positive differential is timing

@@ -55,6 +55,12 @@ def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     from tpu_sandbox.ops.pallas_common import default_interpret
 
     compiled = not default_interpret(None)
+    if resolved == "s2dt" and fused_conv is False:
+        # the transposed plan has no unfused-conv mode; honor the kill
+        # switch by dropping to the NHWC s2d plan instead of ignoring it
+        # (ADVICE r03: fused_conv=False under plan='auto' must still
+        # disable the Pallas convs)
+        resolved = "s2d"
     if resolved == "s2dt":
         return ConvNetS2DT(fused_tail=compiled if fused is None else fused,
                            **kwargs)

@@ -14,9 +14,11 @@ convs they replaced — because with channels on the 128-lane minor dim the
 operands are lane-padded up to 8x in HBM. Putting channels on SUBLANES
 and W on lanes (ops/pallas_conv_t.py) made the tile build tile-aligned
 sublane stacking: conv1 fwd 24.6 -> 15.3 ms, conv1 fwd+BN-stats
-29.1 -> 15.3 ms (the stats fusion became free), conv2 bwd 57.6 -> 41.1 ms
-at bs=16, with the fused tail pair (ops/pallas_bn_tail_t.py) keeping the
-BN/ReLU/pool chain at one HBM pass per direction.
+29.1 -> 15.3 ms (the stats fusion became free), conv2 bwd
+57.6 -> 27.3-41.1 ms at bs=16 (the range spans the two recorded r03
+sweeps — 25-50% run-to-run spread, see conv_micro_r03_t.jsonl), with the
+fused tail pair (ops/pallas_bn_tail_t.py) keeping the BN/ReLU/pool chain
+at one HBM pass per direction.
 
 Layout plumbing (the only places the transpose exists):
 - input: ``space_to_depth_t`` emits [N, H/4, 16, W/4] straight from the

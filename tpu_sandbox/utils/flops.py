@@ -87,6 +87,63 @@ def convnet_flops(image_size: int, num_classes: int = 10) -> ConvNetFlops:
     return ConvNetFlops(conv1=conv1, conv2=conv2, fc=fc)
 
 
+#: per-(output element) matmul contraction depths of the s2d-plan Pallas
+#: kernels at the production geometry (H=W=image/4): EXECUTED flops per
+#: custom call = 2 * B * H * W * _S2D_KERNEL_K[class]. conv taps run the
+#: scattered 3x3 at the s2d channel widths (conv1: 16 in -> 256 out;
+#: conv2: 64 in -> 128 out); the bn tails' matmuls are the pool
+#: compaction/scatter selections (bn1: [64,256] sel; bn2: [32,128]).
+_S2D_KERNEL_K = {
+    "/conv1/": 9 * 16 * 256,   # in 16 (s2d image), out blk^2*f1 = 256
+    "/conv2/": 9 * 64 * 128,   # in 4*f1 = 64 (pool1), out blk^2*f2 = 128
+    "/bn1.fused/": 256 * 64,   # pool compaction/scatter selection matmuls
+    "/bn2.fused/": 128 * 32,
+}
+
+
+def s2d_custom_call_flops(hlo_text: str, batch: int,
+                          image_size: int) -> dict:
+    """Analytic EXECUTED flops of the Pallas custom calls in a compiled
+    s2d/s2dt train step, counted from the optimized HLO (VERDICT r03
+    weak-7: XLA's cost analysis cannot see into custom calls, so
+    ``flops_per_step_xla`` silently undercounts exactly when the
+    production kernels are in play; composing it with this makes the
+    cross-check real). Counts every custom-call line whose op_name names
+    a model kernel; per-call flops are the kernel's one matmul over the
+    full [B, H, W] geometry, which holds for fwd, dgrad, wgrad, and the
+    tail kernels alike (same contraction per output element)."""
+    import re
+
+    h = w = image_size // 4
+    base = 2.0 * batch * h * w
+    per_class: dict[str, float] = {}
+    count = unmatched = 0
+    for line in hlo_text.splitlines():
+        # a Pallas kernel instruction: `%name = <shape> custom-call(...)`
+        # whose metadata path ends in .../pallas_call (plain XLA
+        # gather/scatter ops under the same module paths must not count)
+        if not re.search(r"= [^=]*custom-call\(", line):
+            continue
+        m = re.search(r'op_name="([^"]*)"', line)
+        path = m.group(1) if m else ""
+        if "/pallas_call" not in path:
+            continue
+        for tag, k in _S2D_KERNEL_K.items():
+            if tag in path:
+                key = tag.strip("/")
+                per_class[key] = per_class.get(key, 0.0) + base * k
+                count += 1
+                break
+        else:
+            unmatched += 1  # a Pallas call this table doesn't know
+    return {
+        "total": sum(per_class.values()),
+        "per_class": per_class,
+        "custom_calls_counted": count,
+        "unmatched_pallas_calls": unmatched,
+    }
+
+
 def transformer_flops(
     n_layers: int, d_model: int, d_ff: int, seq: int, vocab: int
 ) -> dict[str, float]:

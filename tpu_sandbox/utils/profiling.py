@@ -82,6 +82,37 @@ def measure_per_step(run_steps, n: int) -> dict:
     }
 
 
+def measure_per_step_repeated(run_steps, n: int, repeats: int = 3) -> dict:
+    """``measure_per_step`` run ``repeats`` times: publishes the MIN (the
+    least-contended sample — the honest kernel time under a shared,
+    occasionally-hiccuping tunnel) plus every sample, so artifacts carry
+    their own run-to-run spread (VERDICT r03 next-7: the same kernel
+    differed 25-50% between single-shot r03 sweeps; single samples must
+    not drive plan decisions)."""
+    samples = [measure_per_step(run_steps, n) for _ in range(repeats)]
+    times = [s["sec_per_step"] for s in samples]
+    positive = [t for t in times if t > 0] or times
+    best = samples[times.index(min(positive))]
+    # spread is only a repeatability claim when EVERY repeat measured;
+    # with noise-negative samples dropped it would report a lone noisy
+    # sample as perfectly repeatable — publish None + the failure count
+    all_ok = len(positive) == len(times) and min(positive) > 0
+    spread = ((max(positive) - min(positive)) / min(positive)
+              if all_ok else None)
+    out = {
+        **best,
+        "sec_per_step": min(positive),
+        "repeats": repeats,
+        "sec_per_step_samples": [round(t, 6) for t in times],
+        "spread_frac": round(spread, 3) if spread is not None else None,
+        "timing_method": best["timing_method"] + f"; min of {repeats}",
+    }
+    bad = len(times) - len([t for t in times if t > 0])
+    if bad:
+        out["nonpositive_samples"] = bad
+    return out
+
+
 @dataclass
 class StepTimer:
     """Throughput measurement: call start() once, tick(n_items) per step."""
