@@ -229,7 +229,8 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
             flops_xla = float(cost["flops"])
         from tpu_sandbox.utils.flops import s2d_custom_call_flops
         custom = s2d_custom_call_flops(compiled.as_text(), global_batch,
-                                       image_size)
+                                       image_size,
+                                       plan=type(model).__name__)
         if custom["custom_calls_counted"] and flops_xla is not None:
             custom_flops = custom
             if custom.get("unmatched_pallas_calls"):
@@ -905,6 +906,40 @@ def bench_pallas(force_cpu: bool) -> dict:
                                     - r.astype(jnp.float32)))) / scale
         assert rel < 0.05, (nm, rel)
         checks[f"conv3x3_t_grad_{nm}"] = rel
+
+    # the r04 sparse-tap conv1 (what the transposed plan actually runs)
+    from tpu_sandbox.ops.pallas_conv5_t import (
+        conv1_s2d_t,
+        conv1_s2d_t_reference,
+    )
+
+    s_hw = 40 if on_tpu else 12
+    xs = jnp.asarray(rng.normal(size=(2, s_hw, 16, s_hw)), jnp.bfloat16)
+    k5s = jnp.asarray(0.1 * rng.normal(size=(5, 5, 1, 16)), jnp.bfloat16)
+    b5s = jnp.asarray(rng.normal(size=(16,)), jnp.bfloat16)
+    ysp = conv1_s2d_t(xs, k5s, b5s, interpret)
+    ysp_ref = conv1_s2d_t_reference(xs, k5s, b5s)
+    sp_err = float(jnp.max(jnp.abs(ysp.astype(jnp.float32)
+                                   - ysp_ref.astype(jnp.float32))))
+    assert sp_err < 0.15, sp_err
+    checks["conv1_sparse_tap"] = sp_err
+    gsp = jax.grad(
+        lambda k, b: jnp.sum(conv1_s2d_t(xs, k, b, interpret)
+                             .astype(jnp.float32) ** 2),
+        argnums=(0, 1),
+    )(k5s, b5s)
+    gsp_ref = jax.grad(
+        lambda k, b: jnp.sum(conv1_s2d_t_reference(
+            xs.astype(jnp.float32), k.astype(jnp.float32),
+            b.astype(jnp.float32)) ** 2),
+        argnums=(0, 1),
+    )(k5s, b5s)
+    for a, r, nm in zip(gsp, gsp_ref, ("dk5", "db")):
+        scale = max(1.0, float(jnp.max(jnp.abs(r))))
+        rel = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - r.astype(jnp.float32)))) / scale
+        assert rel < 0.05, (nm, rel)
+        checks[f"conv1_sparse_grad_{nm}"] = rel
 
     ytail = jnp.transpose(yb, (0, 1, 3, 2))
     tout, tmu, tvar = fused_bn_relu_pool_t(ytail, gam, bet, co, blk, 1e-5,

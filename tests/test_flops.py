@@ -120,9 +120,14 @@ def test_s2d_custom_call_flops_counts_pallas_calls_only():
         '  %custom-call.5 = bf16[1] custom-call(%a), metadata={op_name='
         '"jit(s)/jvp(jit(take_along_axis))/gather"}',
     ])
-    c = s2d_custom_call_flops(hlo, 16, 3000)
     base = 2.0 * 16 * 750 * 750
+    # transposed plan: conv1 is the sparse-tap union-tile kernel (K=81)
+    c = s2d_custom_call_flops(hlo, 16, 3000, plan="ConvNetS2DT")
     assert c["custom_calls_counted"] == 3
-    assert c["per_class"]["conv1"] == base * 9 * 16 * 256
+    assert c["unmatched_pallas_calls"] == 0
+    assert c["per_class"]["conv1"] == base * 64 * 256
     assert c["per_class"]["conv2"] == base * 9 * 64 * 128
     assert c["per_class"]["bn1.fused"] == base * 256 * 64
+    # NHWC s2d plan: conv1 is the scattered 3x3 (K=9*16)
+    c2 = s2d_custom_call_flops(hlo, 16, 3000, plan="ConvNetS2D")
+    assert c2["per_class"]["conv1"] == base * 9 * 16 * 256

@@ -264,3 +264,26 @@ def test_s2dt_train_step_lowers_for_tpu(monkeypatch):
     labs = jax.ShapeDtypeStruct((1,), jnp.int32)
     jax.jit(step).trace(state, imgs, labs).lower(
         lowering_platforms=("tpu",))
+
+
+def test_sparse_tap_conv1_lowers_for_tpu():
+    """The r04 sparse-tap conv1 (ops/pallas_conv5_t.py) at the
+    production geometry (16 -> 256, W=750): fwd, stats, and the fused
+    wgrad/dbias under real Mosaic."""
+    from tpu_sandbox.ops.pallas_conv5_t import conv1_s2d_t, conv1_s2d_t_stats
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((1, 20, 16, 750)), jnp.bfloat16)
+    k5 = jnp.asarray(rng.standard_normal((5, 5, 1, 16)), jnp.bfloat16)
+    b = jnp.zeros((16,), jnp.bfloat16)
+
+    def loss(x, k, b):
+        return jnp.sum(conv1_s2d_t(x, k, b, False).astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(1, 2)), x, k5, b)
+
+    def loss_stats(x, k, b):
+        y, s, ss = conv1_s2d_t_stats(x, k, b, False)
+        return jnp.sum(y.astype(jnp.float32)) + jnp.sum(s) + jnp.sum(ss)
+
+    _lower_tpu(jax.grad(loss_stats, argnums=(1, 2)), x, k5, b)

@@ -1,0 +1,78 @@
+"""The sparse-tap conv1 kernel (ops/pallas_conv5_t.py) == the
+scattered-3x3 path it replaces — fwd, stats, wgrad/dbias — plus the
+scatter/gather index adjointness the VJP relies on. Interpret mode
+(Mosaic lowering is pinned in tests/test_mosaic_lowering.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sandbox.models.convnet_s2d_t import space_to_depth_t
+from tpu_sandbox.ops.pallas_conv5_t import (
+    conv1_s2d_t,
+    conv1_s2d_t_reference,
+    conv1_s2d_t_stats,
+    gather_dk5,
+    scatter_k5,
+)
+
+
+def _case(n=2, hw=32, f1=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    img = jnp.asarray(rng.standard_normal((n, hw, hw)), dtype)
+    x = space_to_depth_t(img, 4)
+    k5 = jnp.asarray(0.3 * rng.standard_normal((5, 5, 1, f1)), dtype)
+    b = jnp.asarray(rng.standard_normal(f1), dtype)
+    return x, k5, b
+
+
+def test_scatter_gather_adjoint():
+    """<scatter(k), W> == <k, gather(W)> for random operands — the exact
+    identity the custom VJP uses to route dW1 back to dk5."""
+    rng = np.random.default_rng(3)
+    k5 = jnp.asarray(rng.standard_normal((5, 5, 1, 8)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((128, 64)), jnp.float32)
+    lhs = float(jnp.vdot(scatter_k5(k5), w1))
+    rhs = float(jnp.vdot(k5, gather_dk5(w1, 8)))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-5)
+
+
+def test_forward_matches_scattered_3x3():
+    x, k5, b = _case()
+    np.testing.assert_allclose(
+        np.asarray(conv1_s2d_t(x, k5, b)),
+        np.asarray(conv1_s2d_t_reference(x, k5, b)), atol=1e-5)
+
+
+def test_stats_variant_matches():
+    x, k5, b = _case(seed=1)
+    y, s, ss = conv1_s2d_t_stats(x, k5, b)
+    yr = conv1_s2d_t_reference(x, k5, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=1e-5)
+    ya = np.asarray(yr, np.float32)
+    np.testing.assert_allclose(np.asarray(s)[:, 0], ya.sum((0, 1, 3)),
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(ss)[:, 0],
+                               (ya * ya).sum((0, 1, 3)), rtol=1e-5,
+                               atol=1e-3)
+
+
+def test_wgrad_matches_reference_grads():
+    x, k5, b = _case(seed=2)
+    gn = jax.grad(lambda k, b: jnp.sum(conv1_s2d_t(x, k, b) ** 2),
+                  argnums=(0, 1))(k5, b)
+    gr = jax.grad(
+        lambda k, b: jnp.sum(conv1_s2d_t_reference(x, k, b) ** 2),
+        argnums=(0, 1))(k5, b)
+    for a, r, nm in zip(gn, gr, ("dk5", "db")):
+        scale = float(jnp.max(jnp.abs(r)))
+        assert float(jnp.max(jnp.abs(a - r))) / scale < 1e-6, nm
+
+
+def test_image_edges_zero_padded():
+    """SAME padding at the image boundary: a one-block-tall image forces
+    every halo row through the zero-mask path."""
+    x, k5, b = _case(n=1, hw=4, f1=4, seed=4)
+    np.testing.assert_allclose(
+        np.asarray(conv1_s2d_t(x, k5, b)),
+        np.asarray(conv1_s2d_t_reference(x, k5, b)), atol=1e-5)

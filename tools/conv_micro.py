@@ -202,6 +202,8 @@ def main() -> None:
         # sections OOM'd the 16 GB chip on the first run --------
         t_ops = {f"{cname}_{o}" for o in
                  ("fwd_t", "bwd_t", "wgrad_t", "dgrad_t")}
+        if cname == "conv1":
+            t_ops.add("conv1_sparse")
         g_ops = t_ops - {f"{cname}_fwd_t"}
         if not want or (want & t_ops):
             xt = mk((sh["x"][0], sh["x"][1], sh["x"][3], sh["x"][2]))
@@ -256,6 +258,40 @@ def main() -> None:
                                sh["w"][-1]), wf.shape),
                     nbytes(gt.shape) + nbytes(sh["x"]),
                     gt, wf, zb)
+
+        # -------- the r04 sparse-tap conv1 (union tap tile, K=81):
+        # race it against the scattered-3x3 rows above. Executed-flop
+        # basis differs by design (81 vs 144 K-rows) — compare
+        # sec_per_call, not tflops, across kernels --------
+        if cname == "conv1" and (not want or "conv1_sparse" in want):
+            from tpu_sandbox.ops.pallas_conv5_t import (
+                conv1_s2d_t,
+                conv1_s2d_t_stats,
+                conv1_s2d_t_wgrad,
+            )
+
+            fl_sp = 2 * b * hw * hw * 64 * 256
+            k5 = mk((5, 5, 1, 16))
+            b16 = mk((16,))
+
+            def s_sparse(acc, xt, k5, b16):
+                y = conv1_s2d_t(xt + acc.astype(xt.dtype), k5, b16)
+                return red(y)
+            time_op("conv1_fwd_sparse", s_sparse, fl_sp, io_fwd,
+                    xt, k5, b16)
+
+            def s_sparse_stats(acc, xt, k5, b16):
+                y, s, ss = conv1_s2d_t_stats(xt + acc.astype(xt.dtype),
+                                             k5, b16)
+                return red(y)
+            time_op("conv1_fwd_sparse_stats", s_sparse_stats, fl_sp,
+                    io_fwd, xt, k5, b16)
+
+            def s_sparse_wgrad(acc, xt, gt):
+                dw1, db = conv1_s2d_t_wgrad(xt + acc.astype(xt.dtype), gt)
+                return red(dw1) + red(db)
+            time_op("conv1_wgrad_sparse", s_sparse_wgrad, fl_sp,
+                    nbytes(sh["x"]) + nbytes(gt.shape), xt, gt)
 
         if not want or (want & t_ops):
             del xt

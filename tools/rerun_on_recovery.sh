@@ -1,48 +1,59 @@
 #!/bin/bash
 # Probe the wedged tunnel every 4 min (subprocess probe, never bare
-# jax.devices()); when it answers, run the round-3 rerun ladder
+# jax.devices()); when it answers, run the ROUND-4 measurement ladder
 # sequentially. ONE chip process at a time — nothing else may touch the
 # chip while this runs (see memory: tpu-chip-discipline).
 #
-# r03 status before arming: bs=16 s2dt measured 80.36 img/s (1.07x
-# baseline, measured/images_per_sec_s2dt_b16.json); the tunnel wedged
-# before the bs=5 run. The ladder finishes the measured story: parity
-# batch, capacity, the plan race, LM dots-remat, kernel checks,
-# seq scaling.
+# r04 status before arming: the s2dt step lost its ~95ms of layout glue
+# chiplessly (fused input stage + in-layout fc; AOT non-kernel cycles
+# 141.7 -> 65.3 ms, measured/hlo_cycles_s2dt_b16_r04.json). The ladder
+# measures the new step first at both batch sizes (VERDICT r03 next-1/2:
+# bs=16 headline target >=150 img/s; bs=5 is the reference parity batch),
+# then the three never-measured experiments (capacity, lm, seq_scaling)
+# and the repeat-aware kernel micro (next-7: classify the r03 bwd
+# discrepancy as noise or state).
 cd "$(dirname "$0")/.." || exit 1
 log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
 
-log "RECOVERY WATCH started"
+log "RECOVERY WATCH (r04) started"
 while true; do
   if python -c "import bench,sys; sys.exit(0 if bench.accelerator_usable() else 1)" 2>/dev/null; then
     break
   fi
   sleep 240
 done
-log "chip recovered; rerun ladder starting"
+log "chip recovered; r04 ladder starting"
 
-log "R0 images_per_sec bs=5 (s2dt, the reference parity batch)"
-timeout 2400 python bench.py --batch-per-device 5 --steps 15 > measured/images_per_sec_s2dt_b5.json 2> measured/images_per_sec_s2dt_b5.err
+log "R0 images_per_sec bs=16 (new step: fused input + in-layout fc)"
+timeout 2400 python bench.py --batch-per-device 16 --steps 15 > measured/images_per_sec_s2dt_b16_r04.json 2> measured/images_per_sec_s2dt_b16_r04.err
 log "R0 exit $?"
 
-log "R1 capacity (s2dt: AOT says bs=16 at 11.8 GB -> headroom above 16)"
-timeout 3600 python bench.py --metric capacity > measured/capacity_r03.json 2> measured/capacity_r03.err
+log "R1 images_per_sec bs=5 (the reference parity batch)"
+timeout 2400 python bench.py --batch-per-device 5 --steps 15 > measured/images_per_sec_s2dt_b5_r04.json 2> measured/images_per_sec_s2dt_b5_r04.err
 log "R1 exit $?"
 
-log "R2 sweep (batch ladder + plan race: s2dt vs nhwc vs xla)"
-timeout 5400 python bench.py --metric sweep --steps 8 > measured/sweep_r03.json 2> measured/sweep_r03.err
+log "R2 capacity (the reference's OOM experiment, measured at last)"
+timeout 3600 python bench.py --metric capacity > measured/capacity_r04.json 2> measured/capacity_r04.err
 log "R2 exit $?"
 
-log "R3 lm (dots remat, b16)"
-timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r03.json 2> measured/lm_dots_b16_r03.err
+log "R3 conv_micro repeats=3 (spread protocol; bwd discrepancy reclass)"
+timeout 3600 python tools/conv_micro.py --batch 16 > measured/conv_micro_r04.jsonl 2> measured/conv_micro_r04.err
 log "R3 exit $?"
 
-log "R4 pallas (now incl. transposed kernels)"
-timeout 2400 python bench.py --metric pallas > measured/pallas_r03.json 2> measured/pallas_r03.err
+log "R4 lm (dots remat, b16)"
+timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r04.json 2> measured/lm_dots_b16_r04.err
 log "R4 exit $?"
 
-log "R5 seq_scaling"
-timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r03.json 2> measured/seq_scaling_r03.err
+log "R5 pallas kernel checks (incl. transposed kernels) + TFLOPs"
+timeout 2400 python bench.py --metric pallas > measured/pallas_r04.json 2> measured/pallas_r04.err
 log "R5 exit $?"
 
-log "RERUN LADDER DONE"
+log "R6 sweep (batch ladder + plan race: s2dt vs nhwc vs xla)"
+timeout 5400 python bench.py --metric sweep --steps 8 > measured/sweep_r04.json 2> measured/sweep_r04.err
+log "R6 exit $?"
+
+log "R7 seq_scaling"
+timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r04.json 2> measured/seq_scaling_r04.err
+log "R7 exit $?"
+
+log "R04 RERUN LADDER DONE — update BASELINE.md from measured/*_r04.*"

@@ -92,8 +92,11 @@ def block_max_pool_t(y: jnp.ndarray, blk: int, co: int) -> jnp.ndarray:
 
 class _ConvT(nn.Module):
     """Same canonical [5,5,ci,co] kernel + bias variables as ConvNet /
-    ConvNetS2D, applied s2d-scattered in transposed layout via the
-    Pallas kernel (ops/pallas_conv_t.py)."""
+    ConvNetS2D. conv1 (r=4, 1-channel input) runs the sparse-tap
+    union-tile kernel (ops/pallas_conv5_t.py: K=81 -> half the MXU
+    passes of the scattered-3x3 form, whose weight is only 25/144
+    dense); conv2 (r=2, 16-channel input, 69%-dense scatter) keeps the
+    scattered-3x3 kernel (ops/pallas_conv_t.py)."""
 
     shape: tuple[int, ...]
     r: int
@@ -101,14 +104,26 @@ class _ConvT(nn.Module):
 
     @nn.compact
     def __call__(self, x, want_stats: bool = False):
-        from tpu_sandbox.ops.pallas_conv_t import conv3x3_t, conv3x3_t_stats
-
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
         )
         bias = self.param(
             "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
         )
+        if self.r == 4 and self.shape[2] == 1:
+            from tpu_sandbox.ops.pallas_conv5_t import (
+                conv1_s2d_t,
+                conv1_s2d_t_stats,
+            )
+
+            k5 = kernel.astype(self.dtype)
+            b = bias.astype(self.dtype)
+            if want_stats:
+                y, s, ss = conv1_s2d_t_stats(x, k5, b)
+                return y, (s, ss)
+            return conv1_s2d_t(x, k5, b)
+        from tpu_sandbox.ops.pallas_conv_t import conv3x3_t, conv3x3_t_stats
+
         wg = scatter_kernel(kernel.astype(self.dtype), self.r)
         reps = wg.shape[-1] // self.shape[-1]
         bias_g = jnp.tile(bias.astype(self.dtype), reps)
