@@ -668,7 +668,10 @@ def bench_lm(force_cpu: bool, quick: bool = False) -> dict:
         cfg = TransformerConfig(vocab_size=32768, d_model=1024, n_heads=8,
                                 n_layers=12, d_ff=4096, max_len=2048,
                                 dtype=jnp.bfloat16, remat=True,
-                                remat_policy="dots")
+                                remat_policy="dots",
+                                # the fused Pallas CE upcasts in VMEM —
+                                # skip the 4.3 GB fp32 logits round-trip
+                                fp32_logits=False)
         # batch 16: fits under dots-remat (chipless AOT: ~12.7 GB peak) and
         # amortizes the fixed AdamW pass — 4.10 vs 4.78 MB/token at b8
         batch, seq, steps = 16, 2048, 5
@@ -808,7 +811,12 @@ def bench_pallas(force_cpu: bool) -> dict:
     logits = jnp.asarray(rng.normal(size=(64, 32000)), jnp.float32)
     labels = jnp.asarray(rng.integers(0, 32000, size=(64,)), jnp.int32)
     ce = pallas_cross_entropy(logits, labels, interpret=interpret)
-    ce_ref = cross_entropy_loss(logits, labels)
+    # optax DIRECTLY: losses.cross_entropy_loss now dispatches LM-scale
+    # vocabs to the very kernel under test, which would compare the
+    # kernel against itself
+    import optax as _optax
+    ce_ref = _optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
     ce_err = float(jnp.abs(ce - ce_ref))
     assert ce_err < 1e-3, ce_err
     checks["ce_64x32000"] = ce_err
@@ -1100,14 +1108,16 @@ def main():
         # --plan plain/s2d/fp32 line never carries another plan's numbers.
         est_by_plan = {
             "s2dt": {
-                "plan": "s2dt (transposed) + pallas kernels, bs=16 bf16",
-                "aot_bytes_accessed_gb": 25.7,
-                "aot_bw_floor_ms_per_step": 31.4,
+                "plan": "s2dt (transposed) + pallas kernels + fused input "
+                        "stage + in-layout fc + sparse-tap conv1 (r04), "
+                        "bs=16 bf16",
+                "aot_bytes_accessed_gb": 17.8,
+                "aot_bw_floor_ms_per_step": 21.8,
                 "last_measured_images_per_sec": 80.36,
-                "last_measured": "bs=16 bf16, r03 "
+                "last_measured": "bs=16 bf16, r03 PRE-surgery step "
                                  "(measured/images_per_sec_s2dt_b16.json)",
                 "source": "chipless v5e AOT compile "
-                          "(measured/aot_s2dt_b16.jsonl); measured r03",
+                          "(measured/aot_s2dt_b16_r04.jsonl); measured r03",
             },
             "s2d": {
                 "plan": "s2d + pallas conv/tail kernels, bs=16 bf16",

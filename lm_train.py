@@ -77,6 +77,8 @@ def train(args):
 
         attention_fn = flash_attention_fn()
 
+    from tpu_sandbox.ops.losses import _FUSED_CE_MIN_CLASSES
+
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
@@ -84,6 +86,9 @@ def train(args):
         remat_policy=args.remat_policy,
         n_experts=(n if args.parallelism == "ep" else 0),
         router_top_k=args.router_top_k,
+        # when the loss will run the fused Pallas CE (LM-scale vocab),
+        # skip the fp32 logits round-trip — the kernel upcasts in VMEM
+        fp32_logits=args.vocab < _FUSED_CE_MIN_CLASSES,
     )
     # schedule + clipping: the standard LM training kit. Cosine decay
     # warms up linearly for --warmup steps then decays to 10% of --lr over

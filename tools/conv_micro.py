@@ -259,9 +259,9 @@ def main() -> None:
                     nbytes(gt.shape) + nbytes(sh["x"]),
                     gt, wf, zb)
 
-        # -------- the r04 sparse-tap conv1 (union tap tile, K=81):
+        # -------- the r04 sparse-tap conv1 (union tap tile, K=64):
         # race it against the scattered-3x3 rows above. Executed-flop
-        # basis differs by design (81 vs 144 K-rows) — compare
+        # basis differs by design (64 vs 144 K-rows) — compare
         # sec_per_call, not tflops, across kernels --------
         if cname == "conv1" and (not want or "conv1_sparse" in want):
             from tpu_sandbox.ops.pallas_conv5_t import (

@@ -93,7 +93,7 @@ def block_max_pool_t(y: jnp.ndarray, blk: int, co: int) -> jnp.ndarray:
 class _ConvT(nn.Module):
     """Same canonical [5,5,ci,co] kernel + bias variables as ConvNet /
     ConvNetS2D. conv1 (r=4, 1-channel input) runs the sparse-tap
-    union-tile kernel (ops/pallas_conv5_t.py: K=81 -> half the MXU
+    union-tile kernel (ops/pallas_conv5_t.py: K=64 -> half the MXU
     passes of the scattered-3x3 form, whose weight is only 25/144
     dense); conv2 (r=2, 16-channel input, 69%-dense scatter) keeps the
     scattered-3x3 kernel (ops/pallas_conv_t.py)."""

@@ -52,6 +52,14 @@ class TransformerConfig:
     # pays no extra MXU FLOPs (~25% step-time win at the bench config for a
     # modest memory give-back). Ignored when remat=False.
     remat_policy: str = "full"
+    # emit logits in fp32 (the safe default for any consumer). False
+    # skips the cast and returns compute-dtype logits — at b16/s2048/
+    # v32768 the fp32 [32768, 32768] materialization is a 4.3 GB
+    # write+read (~32 ms/step in the r04 AOT cycle ranking) that the
+    # fused Pallas CE makes redundant: it upcasts per row-block in VMEM
+    # (ops/losses.py casts explicitly on the plain path, so loss math is
+    # bit-identical either way — bf16->f32 casts are exact).
+    fp32_logits: bool = True
 
 
 class SelfAttention(nn.Module):
@@ -132,4 +140,6 @@ class TransformerLM(nn.Module):
             x = block_cls(cfg, self.attention_fn, self.mlp_cls, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
-        return jnp.asarray(logits, jnp.float32)
+        if cfg.fp32_logits:
+            return jnp.asarray(logits, jnp.float32)
+        return logits

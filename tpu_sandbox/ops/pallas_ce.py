@@ -72,9 +72,12 @@ def _forward(logits, labels, interpret):
     cp = _round_up(c, _LANE)
     block_n = _block_rows(cp)
     if block_n is None:  # vocab too wide for one VMEM row-block
-        from tpu_sandbox.ops.losses import cross_entropy_loss
+        # plain optax directly — NOT losses.cross_entropy_loss, whose
+        # LM-vocab dispatch would re-enter this function forever
+        import optax
 
-        return cross_entropy_loss(logits, labels)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels).mean()
     np_ = _round_up(n, block_n)
     # pad in the INPUT dtype — the f32 promotion happens inside the kernel
     # per block, so no [N, C] f32 copy ever lands in HBM
