@@ -195,3 +195,41 @@ def test_zero1_matches_plain_dp(mesh8):
     assert fc_spec and fc_spec[0] == "data", fc_spec
     conv_spec = conv_mu.sharding.spec
     assert not conv_spec or conv_spec[0] is None, conv_spec
+
+
+def test_dp_s2dt_fused_input_matches_plain_resize(mesh8):
+    """The full r04 production input path under DataParallel — raw 28x28
+    batch -> fused resize+s2d -> ConvNetS2DT (sparse-tap conv1, fused
+    tails) — computes the same step as the plain ConvNet with
+    resize_on_device, on an 8-shard mesh (fp32, 64x64 target)."""
+    import optax
+
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.models.convnet_s2d_t import ConvNetS2DT
+    from tpu_sandbox.train import TrainState
+
+    tx = optax.sgd(1e-2)
+    plain = ConvNet(dtype=jnp.float32)
+    s2dt = ConvNetS2DT(dtype=jnp.float32, fused_tail=True)
+    state = TrainState.create(
+        plain, jax.random.key(0), jnp.zeros((1, 64, 64, 1)), tx)
+
+    images, labels = synthetic_mnist(n=16, seed=3)
+    images, labels = normalize(images), labels.astype("int32")
+
+    results = {}
+    for name, model in (("plain", plain), ("s2dt", s2dt)):
+        dp = DataParallel(model, tx, mesh8, donate=False,
+                          image_size=(64, 64))
+        dstate = dp.shard_state(state)
+        di, dl = dp.shard_batch(images, labels)
+        new_state, losses = dp.train_step(dstate, di, dl)
+        results[name] = (float(jnp.mean(losses)), new_state.params)
+
+    np.testing.assert_allclose(results["s2dt"][0], results["plain"][0],
+                               rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5),
+        results["s2dt"][1], results["plain"][1],
+    )
