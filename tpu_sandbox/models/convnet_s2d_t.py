@@ -35,6 +35,7 @@ scatter are shared unchanged.
 from __future__ import annotations
 
 import functools
+import os
 
 import flax.linen as nn
 import jax
@@ -96,7 +97,10 @@ class _ConvT(nn.Module):
     union-tile kernel (ops/pallas_conv5_t.py: K=64 -> half the MXU
     passes of the scattered-3x3 form, whose weight is only 25/144
     dense); conv2 (r=2, 16-channel input, 69%-dense scatter) keeps the
-    scattered-3x3 kernel (ops/pallas_conv_t.py)."""
+    scattered-3x3 kernel (ops/pallas_conv_t.py).
+    TPU_SANDBOX_NO_SPARSE_CONV1=1 reverts conv1 to the scattered-3x3
+    kernel — the whole-model A/B lever for the first on-chip runs of the
+    r04 kernel (tools/conv_micro.py races the two directly)."""
 
     shape: tuple[int, ...]
     r: int
@@ -110,7 +114,12 @@ class _ConvT(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
         )
-        if self.r == 4 and self.shape[2] == 1:
+        # read at TRACE time: set the var before the process first traces
+        # the step (each bench/test invocation is its own process under
+        # the one-chip-process discipline); flipping it after a jitted
+        # step compiled is a no-op — the jit cache key ignores env
+        no_sparse = os.environ.get("TPU_SANDBOX_NO_SPARSE_CONV1") == "1"
+        if self.r == 4 and self.shape[2] == 1 and not no_sparse:
             from tpu_sandbox.ops.pallas_conv5_t import (
                 conv1_s2d_t,
                 conv1_s2d_t_stats,
