@@ -335,14 +335,13 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
         image_size, configs = 128, [("fp32", 2, None, None),
                                     ("fp32", 4, None, None)]
     else:
-        # ladder chosen around the chipless AOT capacity estimates for the
-        # s2d plan with the fused tail (bs=16 fits at ~15.3 GB peak, bs=17+
-        # OOMs; measured/aot_capacity_s2d_fused.jsonl): dense near the
-        # expected best point, plus one past-capacity row so the OOM
-        # boundary lands in the table. The kernel-plan rows race the three
-        # execution plans at the best batch — the first r03 chip run
-        # measured the Pallas-conv plan ~5x over its AOT floor, so which
-        # plan actually wins on hardware is an open measured question.
+        # ladder around the chipless AOT capacity estimates (r04 step:
+        # bs=21 fits at ~15.1 GB peak, 22 over —
+        # measured/aot_capacity_s2dt_r04.jsonl): dense near the expected
+        # best point up to the capacity edge. The kernel-plan rows race
+        # the execution plans (and the r04 sparse-vs-scattered conv1) at
+        # the headline batch — which plan actually wins on hardware is a
+        # measured question, not an estimated one.
         configs = [("bf16", 5, None, None), ("bf16", 8, None, None),
                    ("bf16", 12, None, None), ("bf16", 16, None, None),
                    ("bf16", 20, None, None), ("fp32", 5, None, None)]
@@ -353,7 +352,14 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
             # The nhwc_pallas row only races when the main rows run the
             # transposed plan (else it would duplicate them byte-for-byte).
             if resolve_plan(image_size, plan) == "s2dt":
-                configs += [("bf16", 16, dict(plan="s2d"), "nhwc_pallas")]
+                configs += [
+                    ("bf16", 16, dict(plan="s2d"), "nhwc_pallas"),
+                    # the r04 conv1 race: transposed plan, scattered-3x3
+                    # conv1 instead of the sparse union-tile kernel
+                    ("bf16", 16, dict(plan="s2dt", sparse_conv1=False),
+                     "s2dt_scat_conv1"),
+                    ("bf16", 21, None, None),  # AOT r04: max batch 21
+                ]
             configs += [
                 ("bf16", 16, dict(plan="s2d", fused_conv=False),
                  "xla_conv+tail"),

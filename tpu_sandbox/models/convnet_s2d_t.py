@@ -105,6 +105,7 @@ class _ConvT(nn.Module):
     shape: tuple[int, ...]
     r: int
     dtype: jnp.dtype
+    sparse: bool = True  # conv1's union-tile kernel (in-process A/B lever)
 
     @nn.compact
     def __call__(self, x, want_stats: bool = False):
@@ -114,12 +115,15 @@ class _ConvT(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
         )
-        # read at TRACE time: set the var before the process first traces
-        # the step (each bench/test invocation is its own process under
-        # the one-chip-process discipline); flipping it after a jitted
-        # step compiled is a no-op — the jit cache key ignores env
+        # env var read at TRACE time: set it before the process first
+        # traces the step (each bench/test invocation is its own process
+        # under the one-chip-process discipline); flipping it after a
+        # jitted step compiled is a no-op — the jit cache key ignores
+        # env. In-process A/B goes through the `sparse` field instead
+        # (ConvNetS2DT(sparse_conv1=False) retraces properly).
         no_sparse = os.environ.get("TPU_SANDBOX_NO_SPARSE_CONV1") == "1"
-        if self.r == 4 and self.shape[2] == 1 and not no_sparse:
+        if (self.r == 4 and self.shape[2] == 1 and self.sparse
+                and not no_sparse):
             from tpu_sandbox.ops.pallas_conv5_t import (
                 conv1_s2d_t,
                 conv1_s2d_t_stats,
@@ -249,6 +253,7 @@ class ConvNetS2DT(nn.Module):
     dtype: jnp.dtype = jnp.float32  # compute dtype; params stay fp32
     use_bn: bool = True
     fused_tail: bool = False
+    sparse_conv1: bool = True  # False: scattered-3x3 conv1 (A/B lever)
 
     def fused_input_stage(self, images: jnp.ndarray,
                           image_size: tuple[int, int]) -> jnp.ndarray:
@@ -298,7 +303,7 @@ class ConvNetS2DT(nn.Module):
 
         fuse_stats = self.fused_tail and self.use_bn and train
         y = _ConvT((5, 5, 1, f1), r=4, dtype=self.dtype,
-                   name="conv1")(x, fuse_stats)
+                   sparse=self.sparse_conv1, name="conv1")(x, fuse_stats)
         y, ysums = y if fuse_stats else (y, None)
         y = self._tail(y, f1, 4, "bn1", train, ysums)    # [N,H/4,4*f1,W/4]
 
