@@ -195,3 +195,54 @@ def test_bench_plan_ladder():
     calls.clear()
     run_plan_ladder(record, plan="plain")
     assert calls == [{}]
+
+
+def test_bench_loss_gate_flags_divergence_and_nan():
+    """The loss-plausibility gate (VERDICT r03 next-3): sane losses pass
+    untouched; divergent, NaN, and inf losses get the loss_flag, and
+    non-finite values are stringified so the JSON line stays standard."""
+    from bench import annotate_loss
+
+    r = {}
+    annotate_loss(r, 2.3)
+    assert "loss_flag" not in r
+
+    r = {}
+    annotate_loss(r, 10.1)
+    assert "divergence" in r["loss_flag"]
+
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        r = {"final_loss": bad}
+        annotate_loss(r, bad)
+        assert "loss_flag" in r
+        assert isinstance(r["final_loss"], str)  # json-standard
+
+
+def test_measure_per_step_repeated_min_and_spread():
+    """Repeat protocol (VERDICT r03 next-7): min published with per-sample
+    spread; any noise-negative repeat voids the spread claim and is
+    counted, never averaged in."""
+    from tpu_sandbox.utils.profiling import measure_per_step_repeated
+
+    times = iter([0.040, 0.050, 0.045])
+    import tpu_sandbox.utils.profiling as prof
+
+    def fake(run_steps, n):
+        return {"sec_per_step": next(times), "t_n_sec": 0.0,
+                "t_2n_sec": 0.0, "n": n, "timing_method": "fake"}
+
+    orig = prof.measure_per_step
+    prof.measure_per_step = fake
+    try:
+        out = measure_per_step_repeated(lambda k: None, 2, repeats=3)
+        assert out["sec_per_step"] == 0.040
+        assert out["spread_frac"] == 0.25
+        assert "nonpositive_samples" not in out
+
+        times = iter([-0.001, 0.040, -0.002])
+        out = measure_per_step_repeated(lambda k: None, 2, repeats=3)
+        assert out["sec_per_step"] == 0.040
+        assert out["spread_frac"] is None  # one sample is NOT repeatability
+        assert out["nonpositive_samples"] == 2
+    finally:
+        prof.measure_per_step = orig
