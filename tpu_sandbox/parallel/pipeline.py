@@ -446,12 +446,18 @@ class PipelineParallel:
             return (tok + pos).astype(cfg.dtype)
 
         def head_loss(post, h, targets):
-            """ln_f + lm_head + CE for ONE microbatch -> mean loss."""
+            """ln_f + lm_head + CE for ONE microbatch -> mean loss.
+
+            Logits stay in compute dtype: cross_entropy_loss upcasts on
+            its plain path (bit-identical) and the fused Pallas CE
+            upcasts per row-block in VMEM — no [tokens, vocab] fp32
+            materialization per microbatch (cf. TransformerConfig
+            .fp32_logits)."""
             hn = _layernorm(h, post["ln_f"]).astype(cfg.dtype)
             logits = (
                 hn @ post["lm_head"]["kernel"].astype(cfg.dtype)
-                + post["lm_head"]["bias"]
-            ).astype(jnp.float32)
+                + post["lm_head"]["bias"].astype(cfg.dtype)
+            )
             return cross_entropy_loss(
                 logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
             )
