@@ -246,3 +246,39 @@ def test_measure_per_step_repeated_min_and_spread():
         assert out["nonpositive_samples"] == 2
     finally:
         prof.measure_per_step = orig
+
+
+def test_hlo_traffic_classify_tags():
+    """tools/hlo_traffic.py classify: the r04 input-stage class, conv
+    fwd/bwd provenance, the pallas fallback, and the no-provenance copy
+    bucket (the attribution the round-4 step surgery was driven by)."""
+    import importlib
+    import sys
+
+    sys.path.insert(0, "tools")
+    ht = importlib.import_module("hlo_traffic")
+
+    def line(op_name, extra=""):
+        return (f'  %x = bf16[1] fusion(%a), {extra}'
+                f'metadata={{{{op_name="jit(train_step)/{op_name}"}}}}')
+
+    assert ht.classify(
+        "fusion", line("jvp(ConvNetS2DT.fused_input_stage)/dot"), 0
+    ) == "input-stage-fwd"
+    assert ht.classify(
+        "fusion", line("jvp(M)/conv1/conv"), 1 << 30
+    ) == "conv1-fwd"
+    assert ht.classify(
+        "fusion", line("transpose(jvp(M))/conv2/conv"), 1 << 30
+    ) == "conv2-dgrad"
+    assert ht.classify(
+        "fusion", line("transpose(jvp(M))/conv2/conv"), 1 << 20
+    ) == "conv2-wgrad"
+    assert ht.classify(
+        "custom-call",
+        line("jvp(M)/M._tail/bn9x/pallas_call",
+             extra="tpu_custom_call "),
+        0,
+    ) == "pallas-kernel"
+    assert ht.classify("copy", "  %c = bf16[1] copy(%a)", 0) \
+        == "copy/transpose(no-provenance)"
