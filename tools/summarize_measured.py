@@ -1,0 +1,86 @@
+"""One-screen summary of the measured/ story for a round.
+
+Reads every ``measured/*_r{N}*.json[l]`` artifact plus the current-round
+err files and prints a compact table: headline images/sec lines (with
+plan, loss flag, fallbacks), capacity, kernel micro rows (min + spread),
+lm/seq rows, and which rungs never produced output. Run after the
+recovery ladder (tools/rerun_on_recovery.sh) finishes — or any time, to
+see what is still missing.
+
+Usage: python tools/summarize_measured.py [--round 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+
+def _rows(path):
+    text = open(path).read()
+    try:  # whole-file JSON (indented artifacts like hlo_cycles_*)
+        doc = json.loads(text)
+        return [doc] if isinstance(doc, dict) else [
+            d for d in doc if isinstance(d, dict)]
+    except json.JSONDecodeError:
+        pass
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict):  # bare strings inside indented JSON
+            out.append(d)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, default=4)
+    args = p.parse_args()
+    tag = f"_r{args.round:02d}"
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "measured")
+
+    files = sorted(glob.glob(os.path.join(base, f"*{tag}*")))
+    if not files:
+        print(f"no measured/*{tag}* artifacts yet")
+    for path in files:
+        name = os.path.basename(path)
+        if name.endswith(".err"):
+            size = os.path.getsize(path)
+            if size:
+                tail = open(path, errors="replace").read()[-300:]
+                print(f"-- {name}: {size} B of stderr; tail: ...{tail!r}")
+            continue
+        rows = _rows(path)
+        if not rows:
+            print(f"-- {name}: EMPTY (rung died before its JSON line)")
+            continue
+        print(f"-- {name}")
+        for r in rows:
+            if "metric" in r:
+                bits = [f"{r['metric']}={r.get('value')}",
+                        f"unit={r.get('unit')}"]
+                for k in ("execution_plan", "kernel_plan", "global_batch",
+                          "sec_per_step", "mfu", "final_loss", "loss_flag",
+                          "plan_fallback", "degraded", "spread_frac"):
+                    if r.get(k) is not None:
+                        bits.append(f"{k}={r[k]}")
+                print("   " + "  ".join(str(b) for b in bits))
+            elif "op" in r:
+                print(f"   {r['op']}: {r.get('sec_per_call')}s  "
+                      f"tflops={r.get('tflops')}  "
+                      f"spread={r.get('spread_frac')}"
+                      + ("  INVALID" if r.get("invalid")
+                         or r.get("degraded") else ""))
+
+
+if __name__ == "__main__":
+    main()
