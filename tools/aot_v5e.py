@@ -31,15 +31,20 @@ import sys
 # tools/, which does not see the tpu_sandbox package at the repo root)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
-# lower the REAL Mosaic kernels, not the interpreter (see pallas_common):
-# this process only compiles, never executes
-os.environ.setdefault("TPU_SANDBOX_FORCE_COMPILED_KERNELS", "1")
-
 HBM_BYTES = 16 * 1024**3  # v5e: 16 GiB HBM per chip
 
 
 def make_topology():
+    # env setup lives HERE, not at module import: importing this module
+    # (e.g. tests importing hlo_traffic for its classifier) must not
+    # flip the whole process into forced-compiled-kernel mode — that
+    # poisoned a full pytest run once (interpret-mode CPU tests started
+    # lowering real Mosaic kernels and died)
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    # lower the REAL Mosaic kernels, not the interpreter (see
+    # pallas_common): this process only compiles, never executes
+    os.environ.setdefault("TPU_SANDBOX_FORCE_COMPILED_KERNELS", "1")
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")

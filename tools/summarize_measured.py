@@ -22,8 +22,11 @@ def _rows(path):
     text = open(path).read()
     try:  # whole-file JSON (indented artifacts like hlo_cycles_*)
         doc = json.loads(text)
-        return [doc] if isinstance(doc, dict) else [
-            d for d in doc if isinstance(d, dict)]
+        if isinstance(doc, dict):
+            return [doc]
+        if isinstance(doc, list):
+            return [d for d in doc if isinstance(d, dict)]
+        return []  # scalar JSON (a partial write): report as empty
     except json.JSONDecodeError:
         pass
     out = []
@@ -64,6 +67,7 @@ def main():
             print(f"-- {name}: EMPTY (rung died before its JSON line)")
             continue
         print(f"-- {name}")
+        shown = 0
         for r in rows:
             if "metric" in r:
                 bits = [f"{r['metric']}={r.get('value')}",
@@ -74,12 +78,22 @@ def main():
                     if r.get(k) is not None:
                         bits.append(f"{k}={r[k]}")
                 print("   " + "  ".join(str(b) for b in bits))
-            elif "op" in r:
-                print(f"   {r['op']}: {r.get('sec_per_call')}s  "
+            elif "sec_per_call" in r:  # conv_micro kernel rows
+                print(f"   {r.get('op')}: {r['sec_per_call']}s  "
                       f"tflops={r.get('tflops')}  "
                       f"spread={r.get('spread_frac')}"
                       + ("  INVALID" if r.get("invalid")
                          or r.get("degraded") else ""))
+            elif "bytes_accessed" in r:  # AOT compile rows
+                print(f"   plan={r.get('plan')} batch={r.get('batch')} "
+                      f"bytes={r.get('bytes_accessed')} "
+                      f"peak_gb={r.get('est_peak_gb')} "
+                      f"fits={r.get('fits_16g_hbm')}")
+            else:
+                continue  # per-op traffic / breakdown rows: skip detail
+            shown += 1
+        if not shown:
+            print(f"   ({len(rows)} rows, no summary-known shape)")
 
 
 if __name__ == "__main__":
