@@ -62,7 +62,7 @@ def annotate_loss(result: dict, final_loss: float) -> None:
     because the explanation is known and measured: the reference's own
     recipe (SGD 1e-4 on the ~18M-feature fc head at 3000^2) is divergent
     — one update shifts logits by lr*g*||f||^2 = O(100-1000), and the
-    torch reference model itself measures loss 2.28 -> 150 -> 406 in two
+    torch reference model itself measures loss 2.26 -> 110 -> 421 in two
     steps on this exact config (tools/reference_dynamics_probe.py;
     BASELINE.md "Loss dynamics at 3000^2"). The throughput number is
     sound; the chaotic loss is the architecture's, shared with the
@@ -75,7 +75,7 @@ def annotate_loss(result: dict, final_loss: float) -> None:
         result["loss_flag"] = (
             f"post-warmup loss {final_loss:.2f} > 2x ln(10) init floor: "
             "the reference recipe's own divergence at this scale (torch "
-            "reference: 2.28 -> 406 nats in 2 steps at 3000^2, "
+            "reference: 2.26 -> 421 nats in 2 steps at 3000^2, "
             "tools/reference_dynamics_probe.py), not a numerics defect"
         )
     if not math.isfinite(final_loss):
@@ -254,10 +254,15 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
             cost = cost[0]
         if cost and "flops" in cost:
             flops_xla = float(cost["flops"])
-        from tpu_sandbox.utils.flops import s2d_custom_call_flops
+        from tpu_sandbox.utils.flops import (
+            model_runs_sparse_conv1,
+            s2d_custom_call_flops,
+        )
         custom = s2d_custom_call_flops(compiled.as_text(), global_batch,
                                        image_size,
-                                       plan=type(model).__name__)
+                                       plan=type(model).__name__,
+                                       sparse_conv1=model_runs_sparse_conv1(
+                                           model))
         if custom["custom_calls_counted"] and flops_xla is not None:
             custom_flops = custom
             if custom.get("unmatched_pallas_calls"):
@@ -406,6 +411,16 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
         "rows": rows,
         "device_kind": str(jax.devices()[0].device_kind),
     }
+    if any(r.get("kernel_plan") for r in rows):
+        # only when plan-race rows actually ran (full sweep at 3000^2)
+        result["plan_race_caveat"] = (
+            "NHWC rows (nhwc_pallas, xla_*) include the canonical-fc-order "
+            "transpose of [N,750,750,32] (~0.54 GB bf16/direction at "
+            "bs=16, >=1.3 ms/step of HBM traffic — models/convnet.py); "
+            "the s2dt rows' fc is transpose-free, so part of any "
+            "s2dt-vs-NHWC delta is that canonicalization, not the conv "
+            "kernels (ADVICE r04)."
+        )
     if best is None:
         result["degraded"] = "no config produced a trusted number (see rows)"
     return result

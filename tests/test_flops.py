@@ -121,7 +121,7 @@ def test_s2d_custom_call_flops_counts_pallas_calls_only():
         '"jit(s)/jvp(jit(take_along_axis))/gather"}',
     ])
     base = 2.0 * 16 * 750 * 750
-    # transposed plan: conv1 is the sparse-tap union-tile kernel (K=81)
+    # transposed plan: conv1 is the sparse-tap union-tile kernel (K=64)
     c = s2d_custom_call_flops(hlo, 16, 3000, plan="ConvNetS2DT")
     assert c["custom_calls_counted"] == 3
     assert c["unmatched_pallas_calls"] == 0
@@ -131,3 +131,29 @@ def test_s2d_custom_call_flops_counts_pallas_calls_only():
     # NHWC s2d plan: conv1 is the scattered 3x3 (K=9*16)
     c2 = s2d_custom_call_flops(hlo, 16, 3000, plan="ConvNetS2D")
     assert c2["per_class"]["conv1"] == base * 9 * 16 * 256
+    # ADVICE r04 medium: the EXECUTED kernel choice overrides the class
+    # name — ConvNetS2DT running the scattered-3x3 conv1 (the sweep's
+    # s2dt_scat_conv1 A/B row) must count K=9*16, not the sparse K=64
+    c3 = s2d_custom_call_flops(hlo, 16, 3000, plan="ConvNetS2DT",
+                               sparse_conv1=False)
+    assert c3["per_class"]["conv1"] == base * 9 * 16 * 256
+
+
+def test_model_runs_sparse_conv1_tracks_field_and_env(monkeypatch):
+    """The cross-check keys on the executed conv1 kernel: the model's
+    sparse_conv1 field AND the TPU_SANDBOX_NO_SPARSE_CONV1 kill switch
+    (ADVICE r04 medium)."""
+    from tpu_sandbox.models.convnet_s2d_t import ConvNetS2DT
+    from tpu_sandbox.utils.flops import model_runs_sparse_conv1
+
+    monkeypatch.delenv("TPU_SANDBOX_NO_SPARSE_CONV1", raising=False)
+    assert model_runs_sparse_conv1(ConvNetS2DT())
+    assert not model_runs_sparse_conv1(ConvNetS2DT(sparse_conv1=False))
+    monkeypatch.setenv("TPU_SANDBOX_NO_SPARSE_CONV1", "1")
+    assert not model_runs_sparse_conv1(ConvNetS2DT())
+
+    class NotS2DT:
+        sparse_conv1 = True
+
+    monkeypatch.delenv("TPU_SANDBOX_NO_SPARSE_CONV1", raising=False)
+    assert not model_runs_sparse_conv1(NotS2DT())

@@ -6,11 +6,15 @@ next-3). Torch-CPU replica of the reference stack
 5x5/32 + BN + pool, LazyLinear(10); SGD(1e-4); CE) on bench.py's pixel
 distribution (synthetic MNIST, normalized, 25% label flips).
 
-Measured result (r04, this machine): loss 2.2840 -> 150.66 -> 406.26 ->
-129.54 -> 51.19 -> 0.0000 over six bs=2 steps, logit |max| growing to
-~700. Mechanism: with ~18M post-pool features, one SGD update moves the
-next logits by lr * g * ||f||^2 = O(100-1000) — the recipe is chaotic at
-this scale in ANY framework. The JAX bench's 10.1 nats after 135 steps
+Measured result (r05, this machine, bilinear upsampling matching both
+the reference's transforms.Resize and the JAX bench — ADVICE r04): loss
+2.2628 -> 110.54 -> 421.10 -> 107.99 -> 77.20 -> 0.0000 over six bs=2
+steps, logit |max| growing to ~670
+(measured/reference_dynamics_probe_r05.txt; the earlier mode="nearest"
+run gave 2.2840 -> 150.66 -> 406.26 — same mechanism, different input
+distribution). Mechanism: with ~18M post-pool features, one SGD update
+moves the next logits by lr * g * ||f||^2 = O(100-1000) — the recipe is
+chaotic at this scale in ANY framework. The JAX bench's 10.1 nats after 135 steps
 is the same dynamics (tamer, if anything). Numerics of the s2dt plan are
 separately pinned against the plain plan at production row width in
 tests/test_convnet_s2d_t.py::test_equality_at_production_row_width_bf16.
@@ -69,7 +73,11 @@ for step in range(6):
     sel = sel_rng.integers(0, len(images), size=BS)
     xb = torch.from_numpy(np.asarray(images[sel]).reshape(BS, 28, 28))
     xb = xb.float().unsqueeze(1)  # [B,1,28,28]
-    xb = F.interpolate(xb, size=(IMG, IMG), mode="nearest")
+    # bilinear to match BOTH pipelines (ADVICE r04: the reference's
+    # transforms.Resize is PIL bilinear, the JAX bench resizes bilinear;
+    # the earlier mode="nearest" probed a different input distribution)
+    xb = F.interpolate(xb, size=(IMG, IMG), mode="bilinear",
+                       align_corners=False)
     yb = torch.from_numpy(labels[sel].astype(np.int64))
     out = model(xb)
     loss = crit(out, yb)

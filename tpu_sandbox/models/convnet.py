@@ -62,9 +62,15 @@ class ConvNet(nn.Module):
         # Canonical fc row order is (h, c, w) — the transposed production
         # plan's native feature layout, so its fc contraction runs with
         # ZERO relayout copies (models/convnet_s2d_t.py::_DenseT); the
-        # NHWC plans pay this one small transpose instead. The torch
-        # reference flattens NCHW as (c, h, w) — utils/parity.py
-        # re-blocks between the conventions either way.
+        # NHWC plans pay this transpose instead. NOT free at production
+        # geometry (ADVICE r04): it relayouts [N,750,750,32] (~0.54 GB
+        # bf16 at bs=16) per direction, >=1.3 ms/step of pure HBM traffic
+        # at a v5e's ~819 GB/s even before relayout inefficiency — so
+        # sweep plan-race rows for the NHWC plans (nhwc_pallas, xla_*)
+        # carry this cost and mildly understate those plans vs s2dt
+        # (bench_sweep notes this next to the rows). The torch reference
+        # flattens NCHW as (c, h, w) — utils/parity.py re-blocks between
+        # the conventions either way.
         x = x.transpose(0, 1, 3, 2).reshape(x.shape[0], -1)
         # Flax sizes the kernel from x at init time — LazyLinear semantics.
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)

@@ -31,9 +31,16 @@ _LAYOUT_FILE = "LAYOUT"
 
 
 def _has_steps(directory: Path) -> bool:
-    """Any orbax step directory (numeric child) present?"""
+    """Any pre-existing checkpoint content present? Conservative on
+    purpose (ADVICE r04): pattern-matching numeric step names would let a
+    future non-default orbax ``step_name_format`` (prefixed/padded step
+    dirs) make a pre-canonical checkpoint directory look empty and slip
+    a permuted-row fc kernel past the layout guard. Any child DIRECTORY
+    counts as content (orbax steps are always directories, whatever the
+    step_name_format); plain files (.gitkeep and friends) don't trip the
+    guard."""
     return directory.is_dir() and any(
-        p.name.isdigit() for p in directory.iterdir() if p.is_dir()
+        p.is_dir() for p in directory.iterdir()
     )
 
 

@@ -105,8 +105,22 @@ _S2D_KERNEL_K = {
 _S2DT_OVERRIDES = {"/conv1/": 64 * 256}
 
 
+def model_runs_sparse_conv1(model) -> bool:
+    """Whether this model instance will EXECUTE the sparse-tap conv1
+    kernel, accounting for both the ``sparse_conv1`` field and the
+    TPU_SANDBOX_NO_SPARSE_CONV1 kill switch (read at trace time by
+    models/convnet_s2d_t.py::_ConvT). The FLOP cross-check must key on
+    this, never on the class name alone."""
+    import os
+
+    return (type(model).__name__ == "ConvNetS2DT"
+            and getattr(model, "sparse_conv1", False)
+            and os.environ.get("TPU_SANDBOX_NO_SPARSE_CONV1") != "1")
+
+
 def s2d_custom_call_flops(hlo_text: str, batch: int, image_size: int,
-                          plan: str = "s2dt") -> dict:
+                          plan: str = "s2dt",
+                          sparse_conv1: bool | None = None) -> dict:
     """Analytic EXECUTED flops of the Pallas custom calls in a compiled
     s2d/s2dt train step, counted from the optimized HLO (VERDICT r03
     weak-7: XLA's cost analysis cannot see into custom calls, so
@@ -115,13 +129,25 @@ def s2d_custom_call_flops(hlo_text: str, batch: int, image_size: int,
     cross-check real). Counts every custom-call line whose op_name names
     a model kernel; per-call flops are the kernel's one matmul over the
     full [B, H, W] geometry, which holds for fwd, dgrad, wgrad, and the
-    tail kernels alike (same contraction per output element)."""
+    tail kernels alike (same contraction per output element).
+
+    ``sparse_conv1`` is the EXECUTED conv1 kernel choice, not the model
+    class: ConvNetS2DT can run the scattered-3x3 conv1 (K = 9*16) via
+    ``sparse_conv1=False`` or TPU_SANDBOX_NO_SPARSE_CONV1=1, in which
+    case keying the K table on the class name would undercount every
+    conv1 call by 2.25x while ``unmatched_pallas_calls`` stayed 0 —
+    exactly the silent-wrong-cross-check this function exists to prevent
+    (ADVICE r04 medium). Callers that know the model should pass
+    ``model_runs_sparse_conv1(model)``; None falls back to the plan-name
+    heuristic for HLO-only callers."""
     import re
 
     h = w = image_size // 4
     base = 2.0 * batch * h * w
     table = dict(_S2D_KERNEL_K)
-    if "s2dt" in plan.lower():
+    if sparse_conv1 is None:
+        sparse_conv1 = "s2dt" in plan.lower()
+    if sparse_conv1:
         table.update(_S2DT_OVERRIDES)
     per_class: dict[str, float] = {}
     count = unmatched = 0
