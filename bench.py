@@ -305,19 +305,41 @@ def bench(image_size: int, batch_per_device: int, steps: int, warmup: int,
         "final_loss": round(final_loss, 4),
     }
     annotate_loss(result, final_loss)
+    # framework-regression gate (VERDICT r04 weak-4): the loss_flag's
+    # "reference-recipe chaos" explanation is only available while the
+    # plan provably matches the plain ConvNet at this row width
+    try:
+        pf = numerics_preflight(model, image_size)
+    except Exception as e:  # a preflight crash must not lose the line
+        pf = {"ok": None, "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    result["numerics_preflight"] = pf
+    if pf.get("ok") is False:
+        result["degraded"] = (
+            "numerics preflight FAILED (plan deviates from the plain "
+            f"ConvNet beyond bf16 tolerance: {pf}); loss_flag's "
+            "reference-chaos explanation withdrawn — treat as a "
+            "framework regression"
+        )
+    def add_degraded(msg: str) -> None:
+        # append, never overwrite: the preflight-withdrawal marker must
+        # survive a simultaneous timing/MFU degradation (readers key on
+        # the degraded field)
+        result["degraded"] = (f"{result['degraded']}; {msg}"
+                              if "degraded" in result else msg)
+
     if not timing_ok:
         # differential came out non-positive (timing noise dominated, or the
         # platform queue is lying): no throughput claim at all
         result.update(value=0.0, vs_baseline=0.0, achieved_tflops=0.0,
                       mfu=None)
-        result["degraded"] = (
+        add_degraded(
             f"non-positive differential step time ({sec_per_step:.6f}s): "
             "timing noise or untrusted platform queue; no number published"
         )
     elif not util["plausible"]:
         # an untrusted number is not published at all (the r01 lesson)
         result.update(value=0.0, vs_baseline=0.0)
-        result["degraded"] = (
+        add_degraded(
             f"implausible mfu {util['mfu']:.2f} (> 1.0): timing on this "
             "platform does not reflect device execution; "
             f"untrusted images/sec was {round(ips, 2)}"
@@ -423,6 +445,183 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
         )
     if best is None:
         result["degraded"] = "no config produced a trusted number (see rows)"
+    return result
+
+
+_PREFLIGHT_CACHE: dict = {}
+
+
+def numerics_preflight(model, width: int) -> dict:
+    """The bench's framework-regression gate (VERDICT r04 weak-4/next-4).
+
+    ``annotate_loss`` explains a divergent loss via the reference
+    recipe's own measured chaos — true for the architecture, but on its
+    own it would also wave through a framework-INTRODUCED numerics bug,
+    since every divergence would get the ready-made excuse. This check
+    distinguishes the two inside the bench run itself: the execution
+    plan under test must match the plain ConvNet on a [2, 16, width]
+    bf16 slab (at width=3000 that is the exact production 750-lane row
+    geometry) to the tolerances of tests/test_convnet_s2d_t.py::
+    test_equality_at_production_row_width_bf16. When this FAILS, the
+    chaos explanation is withdrawn and the whole line is degraded.
+    Memoized per (plan config, width): a sweep calls bench() for ~10
+    rows of the same plan, and each preflight costs two full jit
+    compiles on chip."""
+    key = (str(model), width)
+    if key in _PREFLIGHT_CACHE:
+        return _PREFLIGHT_CACHE[key]
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_sandbox.models.convnet import ConvNet
+    from tpu_sandbox.ops.losses import cross_entropy_loss
+
+    if type(model).__name__ == "ConvNet":
+        return {"ok": True,
+                "skipped": "plain plan IS the reference formulation"}
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 16, width, 1)), jnp.bfloat16)
+    yl = jnp.asarray(rng.integers(0, 10, size=(2,)), jnp.int32)
+    ref = ConvNet(dtype=jnp.bfloat16)
+    variables = ref.init(jax.random.key(0), x)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def run(m):
+        def f(p):
+            logits, _ = m.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"])
+            return cross_entropy_loss(logits, yl), logits
+
+        (loss, logits), g = jax.jit(
+            jax.value_and_grad(f, has_aux=True))(params)
+        return (float(loss), np.asarray(logits, np.float32),
+                np.asarray(g["fc"]["kernel"], np.float32))
+
+    l_r, lo_r, g_r = run(ref)
+    # the plan under test, at ITS configured kernels but bf16 compute
+    plan = type(model).__name__
+    l_t, lo_t, g_t = run(model.clone(dtype=jnp.bfloat16))
+    scale = float(np.max(np.abs(lo_r))) or 1.0
+    logit_rel = float(np.max(np.abs(lo_r - lo_t))) / scale
+    loss_abs = abs(l_r - l_t)
+    fc_rel = float(np.max(np.abs(g_r - g_t))) / (float(np.max(np.abs(g_r)))
+                                                 or 1.0)
+    ok = logit_rel < 8e-3 and loss_abs < 8e-3 and fc_rel < 0.05
+    out = {"ok": bool(ok), "plan": plan, "width": width,
+           "logit_rel_dev": round(logit_rel, 6),
+           "loss_abs_dev": round(loss_abs, 6),
+           "fc_grad_rel_dev": round(fc_rel, 6),
+           "tolerances": {"logit_rel": 8e-3, "loss_abs": 8e-3,
+                          "fc_grad_rel": 0.05}}
+    _PREFLIGHT_CACHE[key] = out
+    return out
+
+
+def bench_convergence(image_size: int, steps: int, force_cpu: bool,
+                      plan: str = "auto", batch: int = 5) -> dict:
+    """Tamed-lr convergence at the reference geometry (VERDICT r04
+    next-4): demonstrate the production plan can DECREASE a loss at
+    3000^2 — not merely match a reference recipe that itself diverges
+    (BASELINE.md 'Loss dynamics at 3000^2': SGD 1e-4 moves the next
+    step's logits by lr*g*||f||^2 = O(100-1000) through the ~18M-feature
+    fc head, torch-measured 2.26 -> 421 nats in two steps). The tamed
+    recipe keeps the reference's SGD 1e-4 on the conv/BN trunk and
+    scales the fc head's lr by ~1/||f||^2 (1e-4 / 1e4 -> 1e-8), so the
+    head moves logits O(0.1)/step — the minimal change that makes the
+    architecture trainable at this scale (reference recipe being tamed:
+    /root/reference/mnist_onegpu.py:68-74). Publishes the full loss
+    curve + trend verdict; the numerics preflight runs alongside so a
+    decrease cannot be claimed on a numerically-broken plan."""
+    from tpu_sandbox.utils.cli import ensure_devices
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    degraded = None
+    if force_cpu:
+        ensure_devices(1, force_cpu=True)
+        if image_size > 256:
+            degraded = (f"accelerator unavailable; CPU fallback overrode "
+                        f"image_size {image_size}->256, steps {steps}->12, "
+                        f"batch {batch}->2")
+            image_size, steps, batch = 256, min(steps, 12), 2
+
+    from tpu_sandbox.data import synthetic_mnist
+    from tpu_sandbox.data.mnist import normalize
+    from tpu_sandbox.models import pick_convnet
+    from tpu_sandbox.train import TrainState, make_train_step
+    from tpu_sandbox.utils.profiling import host_sync
+
+    model = pick_convnet(image_size, plan=plan, dtype=jnp.bfloat16)
+    tx = optax.multi_transform(
+        {"head": optax.sgd(1e-8), "trunk": optax.sgd(1e-4)},
+        lambda params: {
+            k: jax.tree.map(lambda _: "head" if k == "fc" else "trunk", v)
+            for k, v in params.items()
+        },
+    )
+    state = TrainState.create(
+        model, jax.random.key(0),
+        jnp.zeros((1, image_size, image_size, 1), jnp.bfloat16), tx)
+    step = make_train_step(model, tx, image_size=(image_size, image_size),
+                           donate=False)
+
+    images, labels = synthetic_mnist(n=batch * 64, seed=0)
+    images, labels = normalize(images), labels.astype("int32")
+    noise_rng = np.random.default_rng(1)
+    flip = noise_rng.random(len(labels)) < 0.25
+    labels = np.where(
+        flip, noise_rng.integers(0, 10, size=len(labels)), labels
+    ).astype("int32")
+    sel_rng = np.random.default_rng(2)
+
+    losses = []
+    for i in range(steps):
+        sel = sel_rng.integers(0, len(images), size=batch)
+        im = jnp.asarray(images[sel])  # normalize() already emits [N,28,28,1]
+        lb = jnp.asarray(labels[sel])
+        state, loss = step(state, im, lb)
+        losses.append(float(host_sync(loss)))
+
+    k = max(1, min(5, steps // 4))
+    first, last = float(np.mean(losses[:k])), float(np.mean(losses[-k:]))
+    drop = first - last
+    rises = sum(1 for a, b in zip(losses, losses[1:]) if b > a + 1e-6)
+    decreased = drop > 0.02 and last < losses[0]
+    try:
+        pf = numerics_preflight(model, image_size)
+    except Exception as e:  # a preflight crash must not lose the curve
+        pf = {"ok": None, "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    result = {
+        "metric": "convergence_tamed_lr",
+        "value": round(drop, 4),
+        "unit": f"nats decrease (mean first {k} -> mean last {k} steps)",
+        "vs_baseline": None,
+        "baseline_kind": ("n/a: the reference's own recipe diverges at "
+                          "this scale (BASELINE.md, torch-measured "
+                          "2.26 -> 421 nats in 2 steps); any decrease "
+                          "beats it"),
+        "decreased": bool(decreased),
+        "image_size": image_size, "batch": batch, "steps": steps,
+        "recipe": "SGD trunk 1e-4, fc head 1e-8 (lr/||f||^2 scaling)",
+        "loss_first_mean": round(first, 4),
+        "loss_last_mean": round(last, 4),
+        "loss_curve": [round(x, 4) for x in losses],
+        "monotone_violations": rises,
+        "execution_plan": type(model).__name__,
+        "device_kind": str(jax.devices()[0].device_kind),
+        "numerics_preflight": pf,
+    }
+    if pf.get("ok") is False:
+        degraded = ((degraded + "; ") if degraded else "") + (
+            "numerics preflight FAILED: the plan deviates from the plain "
+            "ConvNet beyond bf16 tolerance — convergence claim void")
+    if degraded:
+        result["degraded"] = degraded
     return result
 
 
@@ -1035,7 +1234,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
                    choices=["images_per_sec", "allreduce_bw", "pallas",
-                            "capacity", "seq_scaling", "lm", "sweep"],
+                            "capacity", "seq_scaling", "lm", "sweep",
+                            "convergence"],
                    default="images_per_sec",
                    help="which benchmark to run (driver default: images/sec)")
     p.add_argument("--image-size", type=int, default=3000)
@@ -1093,6 +1293,15 @@ def main():
             if args.quick and usable:
                 result["degraded"] = ("--quick shrank the model; not the "
                                       "headline LM config")
+        elif args.metric == "convergence":
+            # --steps' global default (10) is sized for the differential
+            # timer; a convergence CURVE needs more. Only the untouched
+            # default is upgraded — an explicit --steps N is honored.
+            conv_steps = (40 if args.steps == p.get_default("steps")
+                          else args.steps)
+            result = bench_convergence(
+                args.image_size if not args.quick else 128,
+                conv_steps, force_cpu=not usable, plan=args.plan)
         else:
             result = bench_seq_scaling(
                 force_cpu=not usable, quick=args.quick or not usable

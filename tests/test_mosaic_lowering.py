@@ -193,15 +193,18 @@ def test_fused_bn_tail_lowers_for_tpu(blk, co, w):
     _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, gamma, beta)
 
 
+@pytest.mark.parametrize("restage", ["gt", "auto"])
 @pytest.mark.parametrize("c,co", [(16, 256), (64, 128)])
-def test_pallas_conv_t_lowers_for_tpu(c, co):
+def test_pallas_conv_t_lowers_for_tpu(c, co, restage, monkeypatch):
     """VERDICT r03 next-6: the TRANSPOSED conv kernels
     (ops/pallas_conv_t.py) — the plan `auto` resolves to on TPU — at the
     production widths (conv1: 16->256, conv2: 64->128, W=750), fwd + the
     full VJP (flipped-weight dgrad + fused wgrad/dbias) and the stats
-    variant, under real Mosaic lowering."""
+    variant, under real Mosaic lowering. Both wgrad restage variants
+    (r05: explicit-gT native dot vs Mosaic's own lane-lane handling)."""
     from tpu_sandbox.ops.pallas_conv_t import conv3x3_t, conv3x3_t_stats
 
+    monkeypatch.setenv("TPU_SANDBOX_WGRAD_RESTAGE", restage)
     rng = np.random.default_rng(9)
     x = jnp.asarray(rng.standard_normal((1, 20, c, 750)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((3, 3, c, co)), jnp.bfloat16)
@@ -266,12 +269,14 @@ def test_s2dt_train_step_lowers_for_tpu(monkeypatch):
         lowering_platforms=("tpu",))
 
 
-def test_sparse_tap_conv1_lowers_for_tpu():
+@pytest.mark.parametrize("restage", ["gt", "auto"])
+def test_sparse_tap_conv1_lowers_for_tpu(restage, monkeypatch):
     """The r04 sparse-tap conv1 (ops/pallas_conv5_t.py) at the
     production geometry (16 -> 256, W=750): fwd, stats, and the fused
-    wgrad/dbias under real Mosaic."""
+    wgrad/dbias under real Mosaic — both wgrad restage variants."""
     from tpu_sandbox.ops.pallas_conv5_t import conv1_s2d_t, conv1_s2d_t_stats
 
+    monkeypatch.setenv("TPU_SANDBOX_WGRAD_RESTAGE", restage)
     rng = np.random.default_rng(11)
     x = jnp.asarray(rng.standard_normal((1, 20, 16, 750)), jnp.bfloat16)
     k5 = jnp.asarray(rng.standard_normal((5, 5, 1, 16)), jnp.bfloat16)

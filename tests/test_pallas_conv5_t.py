@@ -76,3 +76,62 @@ def test_image_edges_zero_padded():
     np.testing.assert_allclose(
         np.asarray(conv1_s2d_t(x, k5, b)),
         np.asarray(conv1_s2d_t_reference(x, k5, b)), atol=1e-5)
+
+
+def test_differentiated_input_raises():
+    """VERDICT r04 weak-5 / next-7: the zero-input-cotangent contract is
+    GUARDED, not silent. Differentiating through the kernel's input
+    (composing it after trainable preprocessing) must raise at trace
+    time instead of producing silently-zero gradients; the data path
+    (grad wrt weights only) stays allowed. The guard lives at the AD
+    rule (custom_jvp + symbolic_zeros), so it fires across trace
+    boundaries too — grad-of-jit and remat, where a tracer-type check
+    at the wrapper would see only plain jaxpr tracers."""
+    import pytest
+
+    x, k5, b = _case()
+
+    def loss_through_input(scale):
+        # trainable preprocessing: x now depends on a differentiated value
+        return jnp.sum(conv1_s2d_t(x * scale, k5, b))
+
+    with pytest.raises(ValueError, match="ZERO input cotangent"):
+        jax.grad(loss_through_input)(jnp.float32(1.0))
+
+    # ...across a jit boundary (AD of the traced jaxpr, not of python)
+    with pytest.raises(ValueError, match="ZERO input cotangent"):
+        jax.grad(jax.jit(loss_through_input))(jnp.float32(1.0))
+
+    # ...and under rematerialization
+    with pytest.raises(ValueError, match="ZERO input cotangent"):
+        jax.grad(jax.checkpoint(loss_through_input))(jnp.float32(1.0))
+
+    # stats variant carries the same guard
+    with pytest.raises(ValueError, match="ZERO input cotangent"):
+        jax.grad(lambda s: jnp.sum(conv1_s2d_t_stats(x * s, k5, b)[0]))(
+            jnp.float32(1.0))
+
+    # the legitimate composition still differentiates (wrt weights, data x)
+    g = jax.grad(lambda k: jnp.sum(conv1_s2d_t(x, k, b)))(k5)
+    assert g.shape == k5.shape
+    # ...including under jit (the production step is jitted)
+    g2 = jax.jit(jax.grad(lambda k: jnp.sum(conv1_s2d_t(x, k, b))))(k5)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=1e-6)
+
+
+def test_wgrad_restage_variants_agree():
+    """r05 wgrad restage: the explicit-gT native-dot variant and the
+    Mosaic-auto lane-lane variant compute the SAME (dW1, db)."""
+    from tpu_sandbox.ops.pallas_conv5_t import conv1_s2d_t_wgrad
+
+    x, k5, b = _case(seed=5)
+    g = jnp.asarray(
+        np.random.default_rng(6).standard_normal(
+            (x.shape[0], x.shape[1], 16 * k5.shape[-1], x.shape[3])),
+        x.dtype)
+    dw_gt, db_gt = conv1_s2d_t_wgrad(x, g, restage="gt")
+    dw_auto, db_auto = conv1_s2d_t_wgrad(x, g, restage="auto")
+    np.testing.assert_allclose(np.asarray(dw_gt), np.asarray(dw_auto),
+                               rtol=1e-6, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db_gt), np.asarray(db_auto),
+                               rtol=1e-6, atol=1e-4)

@@ -145,3 +145,23 @@ def test_matches_nhwc_kernel_on_s2d_shapes():
         np.asarray(y_t.transpose(0, 1, 3, 2)), np.asarray(y_nhwc),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_wgrad_restage_variants_agree():
+    """r05 wgrad restage: the explicit-gT native-dot variant and the
+    Mosaic-auto lane-lane variant compute the SAME (dwT, db). Small
+    interpret-mode shapes — equality is staging-independent math;
+    production-geometry lowering of both variants is pinned in
+    tests/test_mosaic_lowering.py."""
+    from tpu_sandbox.ops.pallas_conv_t import conv3x3_t_wgrad
+
+    rng = np.random.default_rng(7)
+    for c, co in ((16, 32), (8, 16)):
+        x = jnp.asarray(rng.standard_normal((2, 8, c, 32)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((2, 8, co, 32)), jnp.float32)
+        dw_gt, db_gt = conv3x3_t_wgrad(x, g, restage="gt")
+        dw_auto, db_auto = conv3x3_t_wgrad(x, g, restage="auto")
+        np.testing.assert_allclose(np.asarray(dw_gt), np.asarray(dw_auto),
+                                   rtol=1e-6, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(db_gt), np.asarray(db_auto),
+                                   rtol=1e-6, atol=1e-4)

@@ -150,9 +150,14 @@ def _fwd_stats_kernel(x_ref, up_ref, dn_ref, w1_ref, b_ref,
 
 
 def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
-                  dw_scr, db_scr, *, bh: int, nblk: int):
-    """dW1 [CO, 64] and db [CO, 1] accumulated across the grid; the
-    union tile is rebuilt per row (same build as forward)."""
+                  dw_scr, db_scr, *, bh: int, nblk: int, gt: bool):
+    """Weight-gradient + db accumulated across the grid; the union tile
+    is rebuilt per row (same build as forward). Same two restage
+    variants as pallas_conv_t._wgrad_kernel: ``gt=True`` transposes
+    g_row ([CO=256, W] — 128-aligned) and runs the native
+    tile [64, W] x gT [W, 256] -> dW [64, 256]; ``gt=False`` leaves the
+    lane-lane contraction to Mosaic (which restages the ragged [64, W]
+    tile instead)."""
     n, i = pl.program_id(0), pl.program_id(1)
 
     @pl.when(jnp.logical_and(n == 0, i == 0))
@@ -165,11 +170,19 @@ def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
         g_row = g_ref[0, r]                      # [CO, W]
         db_scr[:] = db_scr[:] + jnp.sum(
             g_row.astype(jnp.float32), axis=1, keepdims=True)
-        dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
-            g_row, _tap_tile_u(get, r),
-            (((1,), (1,)), ((), ())),            # contract W on both
-            preferred_element_type=jnp.float32,
-        )
+        if gt:
+            acc = jax.lax.dot_general(           # [64, CO], native form
+                _tap_tile_u(get, r), g_row.T,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            acc = jax.lax.dot_general(           # [CO, 64]
+                g_row, _tap_tile_u(get, r),
+                (((1,), (1,)), ((), ())),        # contract W on both
+                preferred_element_type=jnp.float32,
+            )
+        dw_scr[:] = dw_scr[:] + acc
 
     @pl.when(jnp.logical_and(n == pl.num_programs(0) - 1, i == nblk - 1))
     def _emit():
@@ -237,35 +250,84 @@ def _prep(k5, bias, dtype):
     return w1, bias_g, f1
 
 
+@jax.custom_jvp
+def _data_only(x):
+    """Identity that REFUSES differentiation through its argument.
+
+    conv1_s2d_t returns a ZERO input cotangent by contract — correct for
+    the production model, whose conv1 input is the image through the
+    fixed linear fused input stage (models/convnet_s2d_t.py), and the
+    zeros let jax DCE the dead dx. But composed after any TRAINABLE
+    preprocessing that contract would silently zero real gradients
+    (VERDICT r04 weak-5). The misuse check must live at the AD-RULE
+    level: a wrapper inspecting tracer types is blind across trace
+    boundaries (under grad-of-jit / remat / scan the forward runs with
+    plain jaxpr tracers and AD happens on the jaxpr afterwards). This
+    shim's JVP rule runs wherever AD actually happens, with
+    symbolic_zeros=True so a data input presents as SymbolicZero and a
+    differentiated input presents as a real tangent — which raises.
+    Under jit with no AD the rule never runs and the identity compiles
+    away."""
+    return x
+
+
+@functools.partial(_data_only.defjvp, symbolic_zeros=True)
+def _data_only_jvp(primals, tangents):
+    (x,), (x_dot,) = primals, tangents
+    if not isinstance(x_dot, jax.custom_derivatives.SymbolicZero):
+        raise ValueError(
+            "conv1_s2d_t's input is being differentiated. This kernel "
+            "returns a ZERO input cotangent by contract — its input "
+            "must be data (the fused input stage output), never a "
+            "function of trainable parameters; composing it after "
+            "trainable preprocessing would produce silently wrong "
+            "gradients. Use the scattered-3x3 conv1 instead "
+            "(ConvNetS2DT(sparse_conv1=False) or "
+            "ops.pallas_conv_t.conv3x3_t, which propagates a real "
+            "input cotangent)."
+        )
+    return _data_only(x), x_dot
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def conv1_s2d_t(x, k5, bias, interpret=None):
-    """Sparse-tap conv1: x [N,H4,16,W4] (s2d-transposed image),
-    k5 [5,5,1,f1] CANONICAL 5x5 weights, bias [f1] ->
-    y [N,H4,16*f1,W4] in x.dtype, f32 accumulation. The x cotangent is
-    zeros (the image is data; jax DCEs it)."""
+def _conv1_s2d_t_prim(x, k5, bias, interpret=None):
     w1, bias_g, _ = _prep(k5, bias, x.dtype)
     return _conv_call(x, w1, bias_g, x.dtype, interpret)
 
 
-def conv1_s2d_t_wgrad(x, g, interpret=None):
+def conv1_s2d_t(x, k5, bias, interpret=None):
+    """Sparse-tap conv1: x [N,H4,16,W4] (s2d-transposed image),
+    k5 [5,5,1,f1] CANONICAL 5x5 weights, bias [f1] ->
+    y [N,H4,16*f1,W4] in x.dtype, f32 accumulation. The x cotangent is
+    zeros (the image is data; jax DCEs it) — a differentiated x is
+    rejected by the AD rule itself, see _data_only."""
+    return _conv1_s2d_t_prim(_data_only(x), k5, bias, interpret)
+
+
+def conv1_s2d_t_wgrad(x, g, interpret=None, restage=None):
     """Fused wgrad+dbias: x [N,H4,16,W4], g [N,H4,CO,W4] ->
-    (dW1 [CO, 64] f32, db [CO, 1] f32)."""
+    (dW1 [CO, 64] f32, db [CO, 1] f32). ``restage`` as in
+    conv3x3_t_wgrad ('gt' native-dot variant is the r05 default)."""
+    from tpu_sandbox.ops.pallas_conv_t import wgrad_restage
+
+    gt = wgrad_restage(restage) == "gt"
     n, h, c, wd = x.shape
     co = g.shape[2]
     bh = _pick_block_h(h, wd, co)
     nblk = h // bh
-    return pl.pallas_call(
-        functools.partial(_wgrad_kernel, bh=bh, nblk=nblk),
-        out_shape=(jax.ShapeDtypeStruct((co, NT), jnp.float32),
+    dw_shape = (NT, co) if gt else (co, NT)
+    dw, db = pl.pallas_call(
+        functools.partial(_wgrad_kernel, bh=bh, nblk=nblk, gt=gt),
+        out_shape=(jax.ShapeDtypeStruct(dw_shape, jnp.float32),
                    jax.ShapeDtypeStruct((co, 1), jnp.float32)),
         grid=(n, nblk),
         in_specs=_halo_specs(bh, nblk, c, wd) + [
             pl.BlockSpec((1, bh, co, wd), lambda n, i: (n, i, 0, 0)),
         ],
-        out_specs=(pl.BlockSpec((co, NT), lambda n, i: (0, 0)),
+        out_specs=(pl.BlockSpec(dw_shape, lambda n, i: (0, 0)),
                    pl.BlockSpec((co, 1), lambda n, i: (0, 0))),
         scratch_shapes=[
-            pltpu.VMEM((co, NT), jnp.float32),
+            pltpu.VMEM(dw_shape, jnp.float32),
             pltpu.VMEM((co, 1), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -274,10 +336,11 @@ def conv1_s2d_t_wgrad(x, g, interpret=None):
         ),
         interpret=default_interpret(interpret),
     )(x, x, x, g)
+    return (dw.T if gt else dw), db
 
 
 def _vjp_fwd(x, k5, bias, interpret):
-    return conv1_s2d_t(x, k5, bias, interpret), (x, k5, bias)
+    return _conv1_s2d_t_prim(x, k5, bias, interpret), (x, k5, bias)
 
 
 def _vjp_bwd(interpret, res, g):
@@ -289,20 +352,25 @@ def _vjp_bwd(interpret, res, g):
     return jnp.zeros_like(x), dk5, db_f1
 
 
-conv1_s2d_t.defvjp(_vjp_fwd, _vjp_bwd)
+_conv1_s2d_t_prim.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def conv1_s2d_t_stats(x, k5, bias, interpret=None):
-    """conv1_s2d_t that also returns (sum [CO,1], sumsq [CO,1]) of the
-    rounded output — same contract as conv3x3_t_stats (stats cotangents
-    ignored; the fused tail's backward accounts for them)."""
+def _conv1_s2d_t_stats_prim(x, k5, bias, interpret=None):
     w1, bias_g, _ = _prep(k5, bias, x.dtype)
     return _conv_call(x, w1, bias_g, x.dtype, interpret, stats=True)
 
 
+def conv1_s2d_t_stats(x, k5, bias, interpret=None):
+    """conv1_s2d_t that also returns (sum [CO,1], sumsq [CO,1]) of the
+    rounded output — same contract as conv3x3_t_stats (stats cotangents
+    ignored; the fused tail's backward accounts for them). Same
+    differentiated-input rejection as conv1_s2d_t."""
+    return _conv1_s2d_t_stats_prim(_data_only(x), k5, bias, interpret)
+
+
 def _stats_vjp_fwd(x, k5, bias, interpret):
-    out = conv1_s2d_t_stats(x, k5, bias, interpret)
+    out = _conv1_s2d_t_stats_prim(x, k5, bias, interpret)
     return out, (x, k5, bias)
 
 
@@ -310,7 +378,7 @@ def _stats_vjp_bwd(interpret, res, cts):
     return _vjp_bwd(interpret, res, cts[0])
 
 
-conv1_s2d_t_stats.defvjp(_stats_vjp_fwd, _stats_vjp_bwd)
+_conv1_s2d_t_stats_prim.defvjp(_stats_vjp_fwd, _stats_vjp_bwd)
 
 
 def conv1_s2d_t_reference(x, k5, bias):

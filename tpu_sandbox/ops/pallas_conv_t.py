@@ -163,10 +163,26 @@ def _fwd_stats_kernel(x_ref, up_ref, dn_ref, wt_ref, b_ref,
 
 
 def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
-                  dw_scr, db_scr, *, bh: int, nblk: int):
-    """Accumulates dwT [CO, 9C] and db [CO, 1] in VMEM scratch across
-    the sequential grid. The dw contraction is over W (lanes of both
-    operands): dwT[co, k] = sum_w g_row[co, w] * tile[k, w]."""
+                  dw_scr, db_scr, *, bh: int, nblk: int, gt: bool):
+    """Accumulates the weight gradient and db [CO, 1] in VMEM scratch
+    across the sequential grid. The contraction is over W, which sits on
+    the LANES of both operands (g_row [CO, W], tile [9C, W]) — not a
+    native MXU form, so SOME operand must be restaged per row. Two
+    variants (VERDICT r04 next-2, the named wgrad bottleneck):
+
+    - ``gt=False`` (r03 form): ``dot_general(g_row, tile, contract W on
+      both)`` -> dwT [CO, 9C]. Mosaic resolves the lane-lane contraction
+      itself, transposing the TILE — a per-row relayout of [9C, W]
+      (9C = 144/576: ragged, non-128-multiple sublane counts).
+    - ``gt=True`` (r05): transpose ``g_row`` explicitly ([CO, W] ->
+      [W, CO]; CO = 256/128 — exact lane-tile multiples) and run the
+      native [M,K]x[K,N] dot ``tile [9C, W] x gT [W, CO] -> dw [9C,
+      CO]``: all three MXU dims >= 128 at production geometry, and the
+      per-row transpose moves 4.5x fewer bytes for conv2 (128x768 vs
+      576x768) and is 128-aligned for both convs.
+
+    Which wins on hardware is a measured question — tools/conv_micro.py
+    races both (rows wgrad[gt] / wgrad[auto])."""
     n, i = pl.program_id(0), pl.program_id(1)
 
     @pl.when(jnp.logical_and(n == 0, i == 0))
@@ -179,11 +195,19 @@ def _wgrad_kernel(x_ref, up_ref, dn_ref, g_ref, dw_ref, db_ref,
         g_row = g_ref[0, r]                    # [CO, W]
         db_scr[:] = db_scr[:] + jnp.sum(
             g_row.astype(jnp.float32), axis=1, keepdims=True)
-        dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
-            g_row, _tap_tile_t(get, r),
-            (((1,), (1,)), ((), ())),          # contract W on both
-            preferred_element_type=jnp.float32,
-        )
+        if gt:
+            acc = jax.lax.dot_general(         # [9C, CO], native form
+                _tap_tile_t(get, r), g_row.T,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            acc = jax.lax.dot_general(         # [CO, 9C]
+                g_row, _tap_tile_t(get, r),
+                (((1,), (1,)), ((), ())),      # contract W on both
+                preferred_element_type=jnp.float32,
+            )
+        dw_scr[:] = dw_scr[:] + acc
 
     @pl.when(jnp.logical_and(n == pl.num_programs(0) - 1, i == nblk - 1))
     def _emit():
@@ -250,26 +274,45 @@ def _conv_vjp_fwd(x, w, bias, interpret):
     return _conv_call(x, w, bias, x.dtype, interpret), (x, w)
 
 
-def conv3x3_t_wgrad(x, g, interpret=None):
+def wgrad_restage(restage: str | None) -> str:
+    """Resolve the wgrad restage choice: explicit argument, else the
+    TPU_SANDBOX_WGRAD_RESTAGE env (read at TRACE time, same discipline
+    as TPU_SANDBOX_NO_SPARSE_CONV1 — models/convnet_s2d_t.py), else the
+    r05 default 'gt'."""
+    import os
+
+    if restage is None:
+        restage = os.environ.get("TPU_SANDBOX_WGRAD_RESTAGE", "gt")
+    if restage not in ("gt", "auto"):
+        raise ValueError(f"wgrad restage must be 'gt' or 'auto': {restage}")
+    return restage
+
+
+def conv3x3_t_wgrad(x, g, interpret=None, restage=None):
     """The fused wgrad+dbias pass alone: x [N,H,C,W], g [N,H,CO,W] ->
     (dwT [CO, 9C] f32, db [CO, 1] f32). Used by the VJP below and timed
-    in isolation by tools/conv_micro.py."""
+    in isolation by tools/conv_micro.py. ``restage`` picks the per-row
+    MXU staging (see _wgrad_kernel): 'gt' transposes g explicitly and
+    runs the native dot; 'auto' leaves the lane-lane contraction to
+    Mosaic; None resolves via wgrad_restage."""
+    gt = wgrad_restage(restage) == "gt"
     n, h, c, wd = x.shape
     co = g.shape[2]
     bh = _pick_block_h(h, wd, c, co)
     nblk = h // bh
-    return pl.pallas_call(
-        functools.partial(_wgrad_kernel, bh=bh, nblk=nblk),
-        out_shape=(jax.ShapeDtypeStruct((co, 9 * c), jnp.float32),
+    dw_shape = (9 * c, co) if gt else (co, 9 * c)
+    dw, db = pl.pallas_call(
+        functools.partial(_wgrad_kernel, bh=bh, nblk=nblk, gt=gt),
+        out_shape=(jax.ShapeDtypeStruct(dw_shape, jnp.float32),
                    jax.ShapeDtypeStruct((co, 1), jnp.float32)),
         grid=(n, nblk),
         in_specs=_halo_specs(bh, nblk, c, wd) + [
             pl.BlockSpec((1, bh, co, wd), lambda n, i: (n, i, 0, 0)),
         ],
-        out_specs=(pl.BlockSpec((co, 9 * c), lambda n, i: (0, 0)),
+        out_specs=(pl.BlockSpec(dw_shape, lambda n, i: (0, 0)),
                    pl.BlockSpec((co, 1), lambda n, i: (0, 0))),
         scratch_shapes=[
-            pltpu.VMEM((co, 9 * c), jnp.float32),
+            pltpu.VMEM(dw_shape, jnp.float32),
             pltpu.VMEM((co, 1), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -278,6 +321,10 @@ def conv3x3_t_wgrad(x, g, interpret=None):
         ),
         interpret=default_interpret(interpret),
     )(x, x, x, g)
+    # caller-facing layout is dwT [CO, 9C] either way; the gt variant's
+    # [9C, CO] is a one-off [576, 128]-ish XLA transpose per step (and
+    # cancels against the VJP's .T below)
+    return (dw.T if gt else dw), db
 
 
 def _conv_vjp_bwd(interpret, res, g):

@@ -47,11 +47,14 @@ def _has_steps(directory: Path) -> bool:
 def _layout_error(directory: Path, found: str) -> ValueError:
     return ValueError(
         f"checkpoint layout mismatch under {directory}: expected "
-        f"'{_LAYOUT}', found '{found}'. Checkpoints from before the "
-        "canonical (h, c, w) fc row order hold the same-shaped fc "
-        "kernel with permuted rows; restoring would silently corrupt "
-        "the model. Re-save from the original code or re-permute "
-        "fc/kernel rows (h,w,c)->(h,c,w)."
+        f"'{_LAYOUT}', found '{found}'. The directory contains "
+        "subdirectories but no layout stamp — either pre-canonical "
+        "checkpoints (saved before the (h, c, w) fc row order: same "
+        "shapes, silently permuted rows — restoring would corrupt the "
+        "model; re-save from the original code or re-permute fc/kernel "
+        "rows (h,w,c)->(h,c,w)) or unrecognized subdirectories this "
+        "guard conservatively refuses to stamp over (point `directory` "
+        "at a dedicated checkpoint dir)."
     )
 
 
