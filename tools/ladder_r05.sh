@@ -1,0 +1,71 @@
+#!/bin/bash
+# ROUND-5 measurement ladder — run sequentially the moment the chip
+# answers (exec'd by tools/rerun_on_recovery.sh so edits to THIS file
+# are picked up at recovery time, not at arm time). ONE chip process at
+# a time — nothing else may touch the chip while this runs.
+#
+# Order (VERDICT r04 next-1/2/4/5/6): the two headline step measurements
+# first (two rounds of chipless surgery are stacked behind them), then
+# the kernel race that decides the r05 wgrad-restage and sparse-conv1
+# defaults, then the never-measured experiments (convergence curve,
+# capacity/OOM, lm), then the wider tables.
+cd "$(dirname "$0")/.." || exit 1
+log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
+
+# Stop LAUNCHING rungs 3.5h after recovery so the chip is free for the
+# driver's end-of-round bench.
+DEADLINE=$(( $(date +%s) + 12600 ))
+rung_ok() {
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    log "DEADLINE reached - leaving the chip for the driver bench"
+    exit 0
+  fi
+}
+
+log "r05 ladder starting"
+
+log "R0 images_per_sec bs=16 (r04 step + r05 gt-wgrad; preflight gate live)"
+timeout 2400 python bench.py --batch-per-device 16 --steps 15 > measured/images_per_sec_s2dt_b16_r05.json 2> measured/images_per_sec_s2dt_b16_r05.err
+log "R0 exit $?"
+
+rung_ok
+log "R1 images_per_sec bs=5 (the reference parity batch)"
+timeout 2400 python bench.py --batch-per-device 5 --steps 15 > measured/images_per_sec_s2dt_b5_r05.json 2> measured/images_per_sec_s2dt_b5_r05.err
+log "R1 exit $?"
+
+rung_ok
+log "R2 conv_micro repeats=3 (gt-vs-auto wgrad race + sparse conv1 race)"
+timeout 3600 python tools/conv_micro.py --batch 16 > measured/conv_micro_r05.jsonl 2> measured/conv_micro_r05.err
+log "R2 exit $?"
+
+rung_ok
+log "R3 convergence (tamed-lr loss curve at 3000^2 — VERDICT next-4)"
+timeout 2400 python bench.py --metric convergence > measured/convergence_r05.json 2> measured/convergence_r05.err
+log "R3 exit $?"
+
+rung_ok
+log "R4 capacity (the reference's OOM experiment, measured at last)"
+timeout 3600 python bench.py --metric capacity > measured/capacity_r05.json 2> measured/capacity_r05.err
+log "R4 exit $?"
+
+rung_ok
+log "R5 lm (dots remat, b16)"
+timeout 2400 python bench.py --metric lm > measured/lm_r05.json 2> measured/lm_r05.err
+log "R5 exit $?"
+
+rung_ok
+log "R6 pallas kernel checks + TFLOPs"
+timeout 2400 python bench.py --metric pallas > measured/pallas_r05.json 2> measured/pallas_r05.err
+log "R6 exit $?"
+
+rung_ok
+log "R7 sweep (batch ladder + plan race: s2dt vs scat-conv1 vs nhwc vs xla)"
+timeout 5400 python bench.py --metric sweep --steps 8 > measured/sweep_r05.json 2> measured/sweep_r05.err
+log "R7 exit $?"
+
+rung_ok
+log "R8 seq_scaling"
+timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r05.json 2> measured/seq_scaling_r05.err
+log "R8 exit $?"
+
+log "R05 LADDER DONE - update BASELINE.md from measured/*_r05.*"

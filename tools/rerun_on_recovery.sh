@@ -1,79 +1,18 @@
 #!/bin/bash
 # Probe the wedged tunnel every 4 min (subprocess probe, never bare
-# jax.devices()); when it answers, run the ROUND-4 measurement ladder
-# sequentially. ONE chip process at a time — nothing else may touch the
-# chip while this runs (see memory: tpu-chip-discipline).
-#
-# r04 status before arming: the s2dt step lost its ~95ms of layout glue
-# chiplessly (fused input stage + in-layout fc; AOT non-kernel cycles
-# 141.7 -> 65.3 ms, measured/hlo_cycles_s2dt_b16_r04.json). The ladder
-# measures the new step first at both batch sizes (VERDICT r03 next-1/2:
-# bs=16 headline target >=150 img/s; bs=5 is the reference parity batch),
-# then the three never-measured experiments (capacity, lm, seq_scaling)
-# and the repeat-aware kernel micro (next-7: classify the r03 bwd
-# discrepancy as noise or state).
+# jax.devices()); when it answers, EXEC the round's measurement ladder.
+# The ladder lives in its own file (tools/ladder_r05.sh) precisely so it
+# can be edited while this watcher is armed: bash reads scripts
+# incrementally, so editing a RUNNING script corrupts it, but exec
+# reads the ladder fresh at recovery time (see memory:
+# tpu-chip-discipline).
 cd "$(dirname "$0")/.." || exit 1
-log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
-
-# Global deadline: stop LAUNCHING rungs 3.5h after the chip recovers so
-# the chip is free for the driver's end-of-round bench (worst-case rung
-# timeouts sum to ~7h — holding the chip that long would collide with
-# the one run that produces BENCH_r04.json). R0-R3 are the critical
-# measurements and land well inside the window.
-rung_ok() {
-  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
-    log "DEADLINE reached - leaving the chip for the driver bench"
-    exit 0
-  fi
-}
-
-log "RECOVERY WATCH (r04) started"
+echo "=== RECOVERY WATCH (r05) started $(date +%T) ===" >> measured/run_log.txt
 while true; do
   if python -c "import bench,sys; sys.exit(0 if bench.accelerator_usable() else 1)" 2>/dev/null; then
     break
   fi
   sleep 240
 done
-log "chip recovered; r04 ladder starting"
-DEADLINE=$(( $(date +%s) + 12600 ))
-
-log "R0 images_per_sec bs=16 (new step: fused input + in-layout fc)"
-timeout 2400 python bench.py --batch-per-device 16 --steps 15 > measured/images_per_sec_s2dt_b16_r04.json 2> measured/images_per_sec_s2dt_b16_r04.err
-log "R0 exit $?"
-
-rung_ok
-log "R1 images_per_sec bs=5 (the reference parity batch)"
-timeout 2400 python bench.py --batch-per-device 5 --steps 15 > measured/images_per_sec_s2dt_b5_r04.json 2> measured/images_per_sec_s2dt_b5_r04.err
-log "R1 exit $?"
-
-rung_ok
-log "R2 capacity (the reference's OOM experiment, measured at last)"
-timeout 3600 python bench.py --metric capacity > measured/capacity_r04.json 2> measured/capacity_r04.err
-log "R2 exit $?"
-
-rung_ok
-log "R3 conv_micro repeats=3 (spread protocol; bwd discrepancy reclass)"
-timeout 3600 python tools/conv_micro.py --batch 16 > measured/conv_micro_r04.jsonl 2> measured/conv_micro_r04.err
-log "R3 exit $?"
-
-rung_ok
-log "R4 lm (dots remat, b16)"
-timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r04.json 2> measured/lm_dots_b16_r04.err
-log "R4 exit $?"
-
-rung_ok
-log "R5 pallas kernel checks (incl. transposed kernels) + TFLOPs"
-timeout 2400 python bench.py --metric pallas > measured/pallas_r04.json 2> measured/pallas_r04.err
-log "R5 exit $?"
-
-rung_ok
-log "R6 sweep (batch ladder + plan race: s2dt vs nhwc vs xla)"
-timeout 5400 python bench.py --metric sweep --steps 8 > measured/sweep_r04.json 2> measured/sweep_r04.err
-log "R6 exit $?"
-
-rung_ok
-log "R7 seq_scaling"
-timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r04.json 2> measured/seq_scaling_r04.err
-log "R7 exit $?"
-
-log "R04 RERUN LADDER DONE — update BASELINE.md from measured/*_r04.*"
+echo "=== chip recovered $(date +%T) ===" >> measured/run_log.txt
+exec bash tools/ladder_r05.sh
