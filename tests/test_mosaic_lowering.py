@@ -292,3 +292,22 @@ def test_sparse_tap_conv1_lowers_for_tpu(restage, monkeypatch):
         return jnp.sum(y.astype(jnp.float32)) + jnp.sum(s) + jnp.sum(ss)
 
     _lower_tpu(jax.grad(loss_stats, argnums=(1, 2)), x, k5, b)
+
+
+def test_pallas_fc_dgrad_lowers_for_tpu():
+    """The r05 fc input-grad kernel (ops/pallas_fc_t.py) at production
+    geometry: K=10 classes, C=32, W=750, bs=16 — the scalar-FMA
+    accumulation with g in SMEM, under real Mosaic."""
+    from tpu_sandbox.ops.pallas_fc_t import fc_t
+
+    rng = np.random.default_rng(12)
+    y = jnp.asarray(rng.standard_normal((16, 30, 32, 750)), jnp.bfloat16)
+    kernel = jnp.asarray(
+        rng.standard_normal((30 * 32 * 750, 10)) * 1e-4, jnp.float32)
+    bias = jnp.zeros((10,), jnp.float32)
+
+    def loss(y, kernel, bias):
+        return jnp.sum(fc_t(y, kernel, bias, jnp.bfloat16, False)
+                       .astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, kernel, bias)

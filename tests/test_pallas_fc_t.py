@@ -1,0 +1,121 @@
+"""The Pallas fc head (ops/pallas_fc_t.py) == the plain einsum path it
+wraps — forward, input-grad (the Pallas kernel), weight/bias grads (the
+unchanged XLA dots) — in interpret mode; Mosaic lowering at production
+geometry is pinned in tests/test_mosaic_lowering.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sandbox.ops.pallas_fc_t import fc_dgrad_t, fc_t
+
+
+def _case(n=3, h=8, c=16, w=32, k=10, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    y = jnp.asarray(rng.standard_normal((n, h, c, w)), dtype)
+    kernel = jnp.asarray(
+        0.01 * rng.standard_normal((h * c * w, k)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(k), jnp.float32)
+    return y, kernel, bias
+
+
+def _einsum_ref(y, kernel, bias, dtype):
+    n, h, c, w = y.shape
+    k4 = kernel.astype(dtype).reshape(h, c, w, kernel.shape[-1])
+    return jnp.einsum("nhcw,hcwk->nk", y, k4) + bias.astype(dtype)
+
+
+def test_forward_matches_einsum():
+    y, kernel, bias = _case()
+    np.testing.assert_allclose(
+        np.asarray(fc_t(y, kernel, bias, jnp.float32)),
+        np.asarray(_einsum_ref(y, kernel, bias, jnp.float32)),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_grads_match_einsum_autodiff():
+    """All three cotangents (dy via the Pallas kernel, dkernel/dbias via
+    the same XLA dots autodiff builds) must match the plain path."""
+    y, kernel, bias = _case(seed=1)
+
+    def loss_pallas(y, kernel, bias):
+        return jnp.sum(fc_t(y, kernel, bias, jnp.float32) ** 2)
+
+    def loss_ref(y, kernel, bias):
+        return jnp.sum(_einsum_ref(y, kernel, bias, jnp.float32) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(y, kernel, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(y, kernel, bias)
+    for a, b, nm in zip(gp, gr, ("dy", "dkernel", "dbias")):
+        scale = float(np.max(np.abs(np.asarray(b)))) or 1.0
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0, atol=2e-5 * scale, err_msg=nm)
+
+
+def test_dgrad_kernel_alone():
+    """fc_dgrad_t == the broadcast-sum it replaces, incl. bf16 output
+    rounding and a non-divisible-looking H that exercises block picking."""
+    rng = np.random.default_rng(2)
+    n, k, h, c, w = 4, 10, 6, 8, 16
+    g = jnp.asarray(rng.standard_normal((n, k)), jnp.float32)
+    wt = jnp.asarray(rng.standard_normal((k, h, c, w)), jnp.bfloat16)
+    dy = fc_dgrad_t(g, wt, jnp.bfloat16)
+    ref = jnp.einsum("nk,khcw->nhcw", g,
+                     wt.astype(jnp.float32)).astype(jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(dy, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_bf16_compute_path():
+    """bf16 y (the production compute dtype): fc_t tracks the einsum
+    path within bf16 rounding."""
+    y, kernel, bias = _case(dtype=jnp.bfloat16, seed=3)
+
+    def loss_pallas(kernel):
+        return jnp.sum(fc_t(y, kernel, bias, jnp.bfloat16) ** 2)
+
+    def loss_ref(kernel):
+        return jnp.sum(_einsum_ref(y, kernel, bias, jnp.bfloat16) ** 2)
+
+    gp = jax.grad(loss_pallas)(kernel)
+    gr = jax.grad(loss_ref)(kernel)
+    scale = float(np.max(np.abs(np.asarray(gr)))) or 1.0
+    assert float(np.max(np.abs(np.asarray(gp - gr)))) / scale < 5e-3
+
+
+def test_kill_switch_einsum_path(monkeypatch):
+    """TPU_SANDBOX_NO_PALLAS_FC=1 must keep working (the emergency
+    fallback if the fc kernel fails on the runtime at hand): the model's
+    einsum branch matches the Pallas-path logits and grads to
+    tolerance."""
+    import flax.linen as fnn
+
+    from tpu_sandbox.models.convnet_s2d_t import _DenseT
+
+    rng = np.random.default_rng(4)
+    y = jnp.asarray(rng.standard_normal((2, 8, 16, 32)), jnp.float32)
+
+    def run(env):
+        if env:
+            monkeypatch.setenv("TPU_SANDBOX_NO_PALLAS_FC", "1")
+        else:
+            monkeypatch.delenv("TPU_SANDBOX_NO_PALLAS_FC", raising=False)
+        m = _DenseT(10, jnp.float32)
+        v = m.init(jax.random.key(0), y)
+
+        def f(p):
+            return jnp.sum(m.apply({"params": p}, y) ** 2)
+
+        return m.apply(v, y), jax.grad(f)(v["params"])
+
+    out_p, g_p = run(env=False)
+    out_e, g_e = run(env=True)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_e),
+                               rtol=1e-6, atol=1e-6)
+    for key in ("kernel", "bias"):
+        np.testing.assert_allclose(
+            np.asarray(g_p[key], np.float32),
+            np.asarray(g_e[key], np.float32), rtol=1e-5, atol=1e-5,
+            err_msg=key)

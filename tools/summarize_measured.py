@@ -62,6 +62,13 @@ def main():
                 tail = open(path, errors="replace").read()[-300:]
                 print(f"-- {name}: {size} B of stderr; tail: ...{tail!r}")
             continue
+        if not name.endswith((".json", ".jsonl")):
+            # plain-text artifacts (probe transcripts etc.): present, not
+            # a dead rung — show the first line instead of crying EMPTY
+            first = open(path, errors="replace").readline().strip()
+            print(f"-- {name}: text artifact ({os.path.getsize(path)} B): "
+                  f"{first[:100]}")
+            continue
         rows = _rows(path)
         if not rows:
             print(f"-- {name}: EMPTY (rung died before its JSON line)")

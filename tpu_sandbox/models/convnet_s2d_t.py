@@ -233,6 +233,15 @@ class _DenseT(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros, (self.features,), jnp.float32
         )
+        # r05: the input-grad of this contraction runs a Pallas kernel
+        # that emits dy in the native [N,h,C,W] layout (ops/pallas_fc_t
+        # — kills the ~540 MB dgrad relayout the XLA einsum paid; fwd
+        # and weight-grad stay the same XLA dots). Env kill switch reads
+        # at trace time like TPU_SANDBOX_NO_SPARSE_CONV1.
+        if os.environ.get("TPU_SANDBOX_NO_PALLAS_FC") != "1":
+            from tpu_sandbox.ops.pallas_fc_t import fc_t
+
+            return fc_t(y, kernel, bias, self.dtype)
         k4 = kernel.astype(self.dtype).reshape(h, c, w, self.features)
         out = jnp.einsum("nhcw,hcwk->nk", y, k4)
         return out + bias.astype(self.dtype)
@@ -274,6 +283,13 @@ class ConvNetS2DT(nn.Module):
         aw4 = jnp.asarray(resize_weights(w0, W)).reshape(W // 4, 4, w0)
         x = images.astype(jnp.float32)
         u = jnp.einsum("nij,wbj->nibw", x, aw4)          # [N, h0, 4, W/4]
+        # The 5D->4D (a,b)->16 merge costs one whole-tensor retiling
+        # copy (~6 ms est at bs=16, copy.67 in measured/hlo_cycles; real
+        # bytes ~0.6 GB). A per-a-slice + channel-concat variant was
+        # AOT-raced in r05 and came out est-neutral (47.8 vs 48.0 ms:
+        # the concat just splits the same relayout into four slice
+        # copies + a pad fusion, identical traffic) — recorded here so
+        # it isn't retried.
         v = jnp.einsum("hai,nibw->nhabw", ah4, u)
         return v.reshape(n, H // 4, 16, W // 4).astype(self.dtype)
 
