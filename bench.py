@@ -29,6 +29,7 @@ over all available devices (1 chip = plain jit path of the same step).
 """
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -914,7 +915,11 @@ def bench_lm(force_cpu: bool, quick: bool = False) -> dict:
             logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
         )
 
-    @jax.jit
+    # donate the state like the ConvNet benches (and real training) do:
+    # in-place AdamW updates instead of fresh param/mu/nu output buffers
+    # (~2+ GB at this config), and it matches what tools/aot_lm_cycles.py
+    # attributes chiplessly
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

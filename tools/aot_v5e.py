@@ -32,6 +32,15 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 HBM_BYTES = 16 * 1024**3  # v5e: 16 GiB HBM per chip
+HBM_BW = 819e9            # v5e HBM bandwidth, bytes/sec
+
+
+def unwrap_cost(compiled) -> dict:
+    """compiled.cost_analysis() across jax versions (list vs dict)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return ca
 
 
 def make_topology():
@@ -88,9 +97,7 @@ def compile_step(topo, plan: str, batch: int, image_size: int = 3000,
 
 def analyze(compiled, plan: str, batch: int, remat: bool = False) -> dict:
     ma = compiled.memory_analysis()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0]
+    ca = unwrap_cost(compiled)
     # donated args alias outputs; live peak ~ args + temps
     peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
     return {
@@ -105,7 +112,7 @@ def analyze(compiled, plan: str, batch: int, remat: bool = False) -> dict:
         "est_peak_gb": round(peak / 1024**3, 2),
         "fits_16g_hbm": peak < HBM_BYTES * 0.98,
         "est_step_ms_bw_bound": (
-            round(ca["bytes accessed"] / 819e9 * 1e3, 1)
+            round(ca["bytes accessed"] / HBM_BW * 1e3, 1)
             if ca.get("bytes accessed") else None
         ),
         "source": "chipless v5e AOT compile (XLA estimates, not measurements)",
