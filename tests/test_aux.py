@@ -196,6 +196,19 @@ def test_bench_plan_ladder():
     run_plan_ladder(record, plan="plain")
     assert calls == [{}]
 
+    # under the transposed plan, the r05 fused conv1 backward gets its
+    # own rung BEFORE the plan is abandoned (a compile failure in the
+    # one never-on-chip kernel must not cost the whole s2dt headline);
+    # on other plans that rung dedups away (covered by the s2d/plain
+    # sequences above)
+    calls.clear()
+    run_plan_ladder(record, plan="s2dt")
+    assert calls == [{}, {"fused_conv1_bwd": False},
+                     {"plan": "s2d"},
+                     {"plan": "s2d", "fused_conv": False},
+                     {"plan": "s2d", "fused_conv": False,
+                      "fused_tail": False}]
+
 
 def test_bench_loss_gate_flags_divergence_and_nan():
     """The loss-plausibility gate (VERDICT r03 next-3): sane losses pass

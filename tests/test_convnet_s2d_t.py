@@ -269,3 +269,40 @@ def test_equality_at_production_row_width_bf16():
     fr = np.asarray(g_r["fc"]["kernel"], np.float32)
     ft = np.asarray(g_t["fc"]["kernel"], np.float32)
     assert np.max(np.abs(fr - ft)) / (np.max(np.abs(fr)) or 1.0) < 0.05
+
+
+def test_fused_conv1_bwd_matches_unfused_model():
+    """r05 backward fusion A/B at the model level: ConvNetS2DT with
+    fused_conv1_bwd True vs False — same loss, same grads (the fused
+    path never materializes conv1's cotangent; dcbias excluded from
+    tight comparison, it is analytically ~0 under BN and pure
+    summation noise in both paths)."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 1)), jnp.float32)
+    yl = jnp.asarray(rng.integers(0, 10, size=(2,)), jnp.int32)
+    ref = ConvNetS2DT(features=(8, 8), fused_tail=True,
+                      fused_conv1_bwd=False)
+    fused = ConvNetS2DT(features=(8, 8), fused_tail=True)
+    variables = ref.init(jax.random.key(0), x)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def run(model):
+        def f(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"])
+            return cross_entropy_loss(logits, yl), mut["batch_stats"]
+        (loss, new_stats), g = jax.value_and_grad(f, has_aux=True)(params)
+        return loss, new_stats, g
+
+    l_r, st_r, g_r = run(ref)
+    l_f, st_f, g_f = run(fused)
+    assert abs(float(l_r) - float(l_f)) < 1e-5
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5), st_r, st_f)
+    for path in (("conv1", "kernel"), ("bn1", "scale"), ("bn1", "bias"),
+                 ("conv2", "kernel"), ("fc", "kernel")):
+        a = np.asarray(g_f[path[0]][path[1]], np.float32)
+        b = np.asarray(g_r[path[0]][path[1]], np.float32)
+        scale = float(np.max(np.abs(b))) or 1.0
+        assert float(np.max(np.abs(a - b))) / scale < 3e-5, path

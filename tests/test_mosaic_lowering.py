@@ -311,3 +311,25 @@ def test_pallas_fc_dgrad_lowers_for_tpu():
                        .astype(jnp.float32))
 
     _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), y, kernel, bias)
+
+
+def test_conv1_tail_fused_bwd_lowers_for_tpu():
+    """The r05 fused conv1+tail backward (ops/pallas_conv1_tail_t.py) at
+    production geometry (16 -> 256, pool to 64, W=750): the combined
+    tail-dy-recompute + sparse wgrad kernel, plus the unchanged reduce
+    pass, under real Mosaic."""
+    from tpu_sandbox.ops.pallas_conv1_tail_t import conv1_tail_t
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((1, 20, 16, 750)), jnp.bfloat16)
+    k5 = jnp.asarray(rng.standard_normal((5, 5, 1, 16)), jnp.bfloat16)
+    cb = jnp.zeros((16,), jnp.bfloat16)
+    gamma = jnp.ones((16,), jnp.float32)
+    beta = jnp.zeros((16,), jnp.float32)
+
+    def loss(k5, cb, gamma, beta):
+        out, _, _ = conv1_tail_t(x, k5, cb, gamma, beta, 16, 4, 1e-5,
+                                 False)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2, 3)), k5, cb, gamma, beta)

@@ -1,40 +1,13 @@
 #!/bin/bash
-# Chip-return runbook: highest-value measurements first, bounded wall-clock.
-# Run the moment a probe (bench.py::accelerator_usable in a SUBPROCESS with
-# a timeout — never bare jax.devices(), a wedged tunnel hangs it forever)
-# answers true. Each step appends to measured/run_log.txt; every bench mode
-# prints one JSON line and self-degrades rather than crashing (the
-# images_per_sec mode also ladders down the fused-kernel plans on compile
-# failure — grep the output for "plan_fallback").
+# Chip-return runbook, manual entry point. The actual rung list lives in
+# the CURRENT round's ladder (tools/ladder_r05.sh) — this wrapper exists
+# so "run the priority measurements by hand" has one stable name across
+# rounds. Probe first (bench.py::accelerator_usable in a SUBPROCESS with
+# a timeout — never bare jax.devices(); a wedged tunnel hangs it
+# forever), then exec the ladder. ONE chip process at a time.
 cd "$(dirname "$0")/.." || exit 1
-log() { echo "=== $1 $(date +%T) ===" >> measured/run_log.txt; }
-
-log "P1 images_per_sec (s2d + pallas conv/tail, bs=5 reference shape)"
-timeout 1800 python bench.py > measured/images_per_sec_r03.json 2> measured/images_per_sec_r03.err
-log "P1 exit $?"
-
-log "P1b images_per_sec bs=16 (AOT-sized best batch)"
-timeout 1800 python bench.py --batch-per-device 16 > measured/images_per_sec_b16_r03.json 2> measured/images_per_sec_b16_r03.err
-log "P1b exit $?"
-
-log "P2 pallas kernel checks (flash, CE, bn-tail, conv) + TFLOPs"
-timeout 1800 python bench.py --metric pallas > measured/pallas_r03.json 2> measured/pallas_r03.err
-log "P2 exit $?"
-
-log "P3 lm (dots remat, b16 — the chipless-sized config)"
-timeout 2400 python bench.py --metric lm > measured/lm_dots_b16_r03.json 2> measured/lm_dots_b16_r03.err
-log "P3 exit $?"
-
-log "P4 capacity (the reference's OOM experiment, measured)"
-timeout 2400 python bench.py --metric capacity > measured/capacity_r03.json 2> measured/capacity_r03.err
-log "P4 exit $?"
-
-log "P5 sweep (batch x dtype ladder)"
-timeout 3600 python bench.py --metric sweep --steps 5 > measured/sweep_r03.json 2> measured/sweep_r03.err
-log "P5 exit $?"
-
-log "P6 seq_scaling (ring vs flash-ring vs ulysses)"
-timeout 3600 python bench.py --metric seq_scaling > measured/seq_scaling_r03.json 2> measured/seq_scaling_r03.err
-log "P6 exit $?"
-
-log "ALL DONE — update BASELINE.md measured tables from measured/*_r03.json"
+if ! python -c "import bench,sys; sys.exit(0 if bench.accelerator_usable() else 1)"; then
+  echo "chip not answering — arm tools/rerun_on_recovery.sh instead" >&2
+  exit 1
+fi
+exec bash tools/ladder_r05.sh

@@ -50,8 +50,17 @@ def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     resolved = resolve_plan(image_size, plan)
     fused = kwargs.pop("fused_tail", None)
     fused_conv = kwargs.pop("fused_conv", None)
+
+    def drop_s2dt_only(kw):
+        # s2dt-only toggles (sparse_conv1, fused_conv1_bwd) are
+        # meaningless — and unknown — to the other plans; drop them so a
+        # plan-ladder rung like dict(fused_conv1_bwd=False) still works
+        # when 'auto' resolves elsewhere (e.g. s2d on CPU)
+        return {k: v for k, v in kw.items()
+                if k not in ("sparse_conv1", "fused_conv1_bwd")}
+
     if resolved == "plain":
-        return ConvNet(**kwargs)
+        return ConvNet(**drop_s2dt_only(kwargs))
     from tpu_sandbox.ops.pallas_common import default_interpret
 
     compiled = not default_interpret(None)
@@ -67,5 +76,5 @@ def pick_convnet(image_size, *, plan: str = "auto", **kwargs):
     return ConvNetS2D(
         fused_tail=compiled if fused is None else fused,
         fused_conv=compiled if fused_conv is None else fused_conv,
-        **kwargs,
+        **drop_s2dt_only(kwargs),
     )

@@ -108,13 +108,20 @@ class _ConvT(nn.Module):
     sparse: bool = True  # conv1's union-tile kernel (in-process A/B lever)
 
     @nn.compact
-    def __call__(self, x, want_stats: bool = False):
+    def __call__(self, x, want_stats: bool = False,
+                 params_only: bool = False):
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
         )
         bias = self.param(
             "bias", nn.initializers.zeros, (self.shape[-1],), jnp.float32
         )
+        if params_only:
+            # the conv1+tail fused-backward composite (pallas_conv1_tail_t)
+            # spans this module's params and bn1's — the parent fetches
+            # them here (declared under the same names, so the tree is
+            # unchanged) and calls the composite itself
+            return kernel.astype(self.dtype), bias.astype(self.dtype)
         # env var read at TRACE time: set it before the process first
         # traces the step (each bench/test invocation is its own process
         # under the one-chip-process discipline); flipping it after a
@@ -204,6 +211,21 @@ class _GroupedBNT(nn.Module):
         self._update_running(mu, var)
         return out
 
+    def fused_conv1(self, x, k5, cbias, blk: int):
+        """conv1 + this BN's tail as ONE differentiable unit: the r05
+        backward fusion (ops/pallas_conv1_tail_t.py) — conv1's ~4.7 GB
+        output cotangent never round-trips HBM (its only consumer is
+        the conv wgrad; dx is dead). Forward identical to
+        _ConvT(sparse) + self.fused."""
+        from tpu_sandbox.ops.pallas_conv1_tail_t import conv1_tail_t
+
+        out, mu, var = conv1_tail_t(
+            x, k5, cbias, self.scale, self.offset, self.features, blk,
+            self.epsilon,
+        )
+        self._update_running(mu, var)
+        return out
+
 
 class _DenseT(nn.Module):
     """nn.Dense over the transposed feature map WITHOUT materializing the
@@ -263,6 +285,7 @@ class ConvNetS2DT(nn.Module):
     use_bn: bool = True
     fused_tail: bool = False
     sparse_conv1: bool = True  # False: scattered-3x3 conv1 (A/B lever)
+    fused_conv1_bwd: bool = True  # False: unfused conv1/tail backward
 
     def fused_input_stage(self, images: jnp.ndarray,
                           image_size: tuple[int, int]) -> jnp.ndarray:
@@ -318,10 +341,26 @@ class ConvNetS2DT(nn.Module):
             x = space_to_depth_t(x, 4).astype(self.dtype)  # [N,H/4,16,W/4]
 
         fuse_stats = self.fused_tail and self.use_bn and train
-        y = _ConvT((5, 5, 1, f1), r=4, dtype=self.dtype,
-                   sparse=self.sparse_conv1, name="conv1")(x, fuse_stats)
-        y, ysums = y if fuse_stats else (y, None)
-        y = self._tail(y, f1, 4, "bn1", train, ysums)    # [N,H/4,4*f1,W/4]
+        conv1 = _ConvT((5, 5, 1, f1), r=4, dtype=self.dtype,
+                       sparse=self.sparse_conv1, name="conv1")
+        # r05 fused conv1/tail BACKWARD: requires the sparse conv1 and
+        # the fused tail both active (the composite is built from those
+        # kernels). Trace-time env kill switch like the other levers.
+        sparse_on = (self.sparse_conv1
+                     and os.environ.get("TPU_SANDBOX_NO_SPARSE_CONV1")
+                     != "1")
+        fully_fused = (
+            fuse_stats and sparse_on and self.fused_conv1_bwd
+            and os.environ.get("TPU_SANDBOX_NO_FUSED_CONV1_BWD") != "1"
+        )
+        if fully_fused:
+            k5, cbias = conv1(x, params_only=True)
+            y = _GroupedBNT(f1, self.dtype, name="bn1").fused_conv1(
+                x, k5, cbias, 4)                         # [N,H/4,4*f1,W/4]
+        else:
+            y = conv1(x, fuse_stats)
+            y, ysums = y if fuse_stats else (y, None)
+            y = self._tail(y, f1, 4, "bn1", train, ysums)  # [N,H/4,4*f1,W/4]
 
         y = _ConvT((5, 5, f1, f2), r=2, dtype=self.dtype,
                    name="conv2")(y, fuse_stats)

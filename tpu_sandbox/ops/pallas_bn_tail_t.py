@@ -235,11 +235,14 @@ def _vjp_fwd(y, gamma, beta, co, blk, eps, interpret, ysums=None):
     return (out, mu, var), (y, gamma, mu, inv, a_col, b_col, ysums)
 
 
-def _vjp_bwd(co, blk, eps, interpret, res, cts):
+def bwd_reduce(y, g, co, blk, a_col, b_col, mu, inv, interpret):
+    """The backward's FIRST pass — per-channel s1 = Σdz and
+    s2 = Σ dz·t_hat over the whole batch — exposed as a function so the
+    conv1+tail fused backward (ops/pallas_conv1_tail_t.py) can run the
+    identical reduction before its own fused apply+wgrad pass.
+    Returns (s1_co [co], s2_co [co], mu_col, inv_col, sel)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    g = cts[0]  # stats cotangents (cts[1:]) ignored — see docstring
-    y, gamma, mu, inv, a_col, b_col, ysums = res
     n, h, c, w = y.shape
     hb = _grid_rows(h, w, c)
     interp = default_interpret(interpret)
@@ -274,14 +277,29 @@ def _vjp_bwd(co, blk, eps, interpret, res, cts):
         ),
         interpret=interp,
     )(y, a_col, b_col, g, sel, mu_col, inv_col)
-
     groups = blk * blk
-    m_count = n * h * w * groups
     s1_co = jnp.sum(s1[:, 0].reshape(groups, co), axis=0)
     s2_co = jnp.sum(s2[:, 0].reshape(groups, co), axis=0)
+    return s1_co, s2_co, mu_col, inv_col, sel
+
+
+def _vjp_bwd(co, blk, eps, interpret, res, cts):
+    g = cts[0]  # stats cotangents (cts[1:]) ignored — see docstring
+    y, gamma, mu, inv, a_col, b_col, ysums = res
+    n, h, c, w = y.shape
+    hb = _grid_rows(h, w, c)
+    interp = default_interpret(interpret)
+
+    s1_co, s2_co, mu_col, inv_col, sel = bwd_reduce(
+        y, g, co, blk, a_col, b_col, mu, inv, interpret)
+    groups = blk * blk
+    m_count = n * h * w * groups
     gi_col = _col_expand(gamma.astype(jnp.float32) * inv, groups)
     c1_col = _col_expand(s1_co / m_count, groups)
     c2_col = _col_expand(s2_co / m_count, groups)
+
+    def vec():
+        return pl.BlockSpec((c, 1), lambda i, j: (0, 0))
 
     dy = pl.pallas_call(
         functools.partial(_bwd_apply_kernel, co=co, blk=blk),
