@@ -401,7 +401,12 @@ def bench_sweep(image_size: int, steps: int, warmup: int, baseline: float,
                     # conv1 instead of the sparse union-tile kernel
                     ("bf16", 16, dict(plan="s2dt", sparse_conv1=False),
                      "s2dt_scat_conv1"),
-                    ("bf16", 21, None, None),  # AOT r04: max batch 21
+                    # the r05 backward race: unfused conv1/tail backward
+                    # (the cotangent round-trips HBM) vs the default
+                    # fused kernel — the -9.4 GB/step claim, measured
+                    ("bf16", 16, dict(plan="s2dt", fused_conv1_bwd=False),
+                     "s2dt_unfused_bwd"),
+                    ("bf16", 21, None, None),  # AOT r04/r05: max batch 21
                 ]
             configs += [
                 ("bf16", 16, dict(plan="s2d", fused_conv=False),

@@ -283,6 +283,18 @@ def bwd_reduce(y, g, co, blk, a_col, b_col, mu, inv, interpret):
     return s1_co, s2_co, mu_col, inv_col, sel
 
 
+def bwd_scales(s1_co, s2_co, gamma, inv, groups: int, m_count: int):
+    """The BN backward's per-channel normalization columns — the
+    gamma·inv gain and the two centering terms dy = gi·(dz − c1 −
+    t̂·c2) needs. Shared with the fused conv1/tail backward
+    (ops/pallas_conv1_tail_t.py): its equality contract is that this
+    math is THE SAME function, not a copy that can drift."""
+    gi_col = _col_expand(gamma.astype(jnp.float32) * inv, groups)
+    c1_col = _col_expand(s1_co / m_count, groups)
+    c2_col = _col_expand(s2_co / m_count, groups)
+    return gi_col, c1_col, c2_col
+
+
 def _vjp_bwd(co, blk, eps, interpret, res, cts):
     g = cts[0]  # stats cotangents (cts[1:]) ignored — see docstring
     y, gamma, mu, inv, a_col, b_col, ysums = res
@@ -294,9 +306,8 @@ def _vjp_bwd(co, blk, eps, interpret, res, cts):
         y, g, co, blk, a_col, b_col, mu, inv, interpret)
     groups = blk * blk
     m_count = n * h * w * groups
-    gi_col = _col_expand(gamma.astype(jnp.float32) * inv, groups)
-    c1_col = _col_expand(s1_co / m_count, groups)
-    c2_col = _col_expand(s2_co / m_count, groups)
+    gi_col, c1_col, c2_col = bwd_scales(s1_co, s2_co, gamma, inv,
+                                        groups, m_count)
 
     def vec():
         return pl.BlockSpec((c, 1), lambda i, j: (0, 0))

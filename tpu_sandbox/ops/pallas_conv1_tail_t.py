@@ -45,10 +45,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tpu_sandbox.ops.pallas_bn_tail_t import (
-    _col_expand,
     _forward as _tail_forward,
     _row_dz,
     bwd_reduce,
+    bwd_scales,
 )
 from tpu_sandbox.ops.pallas_common import default_interpret
 from tpu_sandbox.ops.pallas_conv5_t import (
@@ -192,28 +192,28 @@ def _fwd_impl(x, k5, cbias, gamma, beta, co, blk, eps, interpret):
 def _vjp_fwd(x, k5, cbias, gamma, beta, co, blk, eps, interpret):
     out, mu, var, y1, (a_col, b_col, inv) = _fwd_impl(
         x, k5, cbias, gamma, beta, co, blk, eps, interpret)
-    return (out, mu, var), (x, k5, y1, gamma, mu, inv, a_col, b_col)
+    return (out, mu, var), (x, k5, cbias, y1, gamma, beta, mu, inv,
+                            a_col, b_col)
 
 
 def _vjp_bwd(co, blk, eps, interpret, res, cts):
     g = cts[0]  # stats cotangents ignored — see docstring
-    x, k5, y1, gamma, mu, inv, a_col, b_col = res
+    x, k5, cbias, y1, gamma, beta, mu, inv, a_col, b_col = res
     n, h, c, w = y1.shape
     groups = blk * blk
     s1_co, s2_co, mu_col, inv_col, sel = bwd_reduce(
         y1, g, co, blk, a_col, b_col, mu, inv, interpret)
     m_count = n * h * w * groups
-    gi_col = _col_expand(gamma.astype(jnp.float32) * inv, groups)
-    c1_col = _col_expand(s1_co / m_count, groups)
-    c2_col = _col_expand(s2_co / m_count, groups)
+    gi_col, c1_col, c2_col = bwd_scales(s1_co, s2_co, gamma, inv,
+                                        groups, m_count)
 
     dw1, db = _fused_wgrad(x, y1, g, a_col, b_col, sel, mu_col, inv_col,
                            gi_col, c1_col, c2_col, co, blk, interpret)
     f1 = k5.shape[-1]
     dk5 = gather_dk5(dw1, f1).astype(k5.dtype)
-    db_f1 = db[:, 0].reshape(R * R, f1).sum(0).astype(k5.dtype)
+    db_f1 = db[:, 0].reshape(R * R, f1).sum(0).astype(cbias.dtype)
     dgamma = s2_co.astype(gamma.dtype)
-    dbeta = s1_co.astype(gamma.dtype)
+    dbeta = s1_co.astype(beta.dtype)
     return jnp.zeros_like(x), dk5, db_f1, dgamma, dbeta
 
 
