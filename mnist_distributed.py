@@ -127,11 +127,11 @@ def train_multiprocess_worker(args, world_size):
     DistributedSampler shard and assembles the global batch with
     make_array_from_process_local_data; the jit'd shard_map step then runs
     SPMD across processes with cross-process grad pmean."""
-    import jax
+    from tpu_sandbox.utils.cli import configure_worker_cpu
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    configure_worker_cpu(1)
 
+    import jax  # noqa: F401  (platform configured above, before first use)
     import numpy as np
 
     from tpu_sandbox.runtime import Heartbeat, bootstrap, wait_for_world
@@ -207,6 +207,251 @@ def train_multiprocess_worker(args, world_size):
     bootstrap.cleanup()
     if hb is not None:
         hb.stop(deregister=True)
+
+
+def train_elastic_worker(args, world_size):
+    """One rank of an elastic generation: heartbeat + generation-scoped
+    rendezvous, fault injection from the env plan, resumable training with
+    coordination-free checkpointing (rank 0 writes ``HostCheckpoint`` npz
+    files, every rank reads them back), and SIGTERM → save → exit 75 so the
+    supervisor restarts the generation without charging its budget."""
+    import os
+    import sys
+
+    from tpu_sandbox.utils.cli import configure_worker_cpu
+
+    configure_worker_cpu(1)
+
+    import jax
+    import numpy as np
+
+    from tpu_sandbox.runtime import Heartbeat, bootstrap, wait_for_world
+    from tpu_sandbox.runtime.faults import FaultInjector, FaultPlan
+    from tpu_sandbox.runtime.kvstore import KVClient
+    from tpu_sandbox.train import (
+        PREEMPTED_EXIT_CODE,
+        Preempted,
+        PreemptionHandler,
+        TrainState,
+        train_resumable,
+    )
+    from tpu_sandbox.train.checkpoint import HostCheckpoint
+
+    rank = args.rank
+    kv = KVClient(port=int(args.kv_port))
+    hb = Heartbeat(kv, rank, interval=0.5).start()
+    preemption = PreemptionHandler(kv)
+    plan = FaultPlan.from_env()
+    injector = None
+    if plan.faults:
+        # hang_heartbeat: stop beating but stay alive — exercises the
+        # supervisor's watchdog (wedged-not-dead) path
+        injector = FaultInjector(
+            plan, rank, kv,
+            on_hang_heartbeat=lambda: hb.stop(deregister=False),
+        )
+    wait_for_world(kv, world_size, rank, timeout=120.0)
+    bootstrap.init(
+        coordinator=f"127.0.0.1:{args.port}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+    # AFTER bootstrap.init: jax.distributed installs XLA's own SIGTERM
+    # notifier, and whoever installs last owns the signal — ours must win
+    # or a preemption notice trains straight through to completion
+    preemption.install()
+
+    import jax.numpy as jnp
+
+    from tpu_sandbox.data import BatchLoader
+    from tpu_sandbox.data.sampler import DistributedSampler
+    from tpu_sandbox.models import pick_convnet
+    from tpu_sandbox.parallel import DataParallel
+    from tpu_sandbox.runtime.mesh import make_mesh
+    from tpu_sandbox.runtime.multihost import global_batch_from_local
+
+    mesh = make_mesh({"data": world_size})
+    image_shape = [args.image_size, args.image_size]
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    model = pick_convnet(args.image_size, plan=args.plan,
+                         num_classes=10, dtype=dtype)
+    tx = make_optimizer(args)
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros([1, *image_shape, 1], dtype), tx
+    )
+    template = state.host_view()  # restore target, before sharding
+
+    images, labels = load_training_arrays(args, world_size)
+    sampler = DistributedSampler(len(images), world_size, rank, seed=0)
+    local_loader = BatchLoader(images, labels, args.batch_size,
+                               sampler=sampler, drop_last=True)
+
+    class GlobalLoader:
+        def __len__(self):
+            return len(local_loader)
+
+        def set_epoch(self, epoch):
+            local_loader.set_epoch(epoch)
+
+        def __iter__(self):
+            for imgs, labs in local_loader:
+                yield (
+                    global_batch_from_local(mesh, np.asarray(imgs)),
+                    global_batch_from_local(mesh, np.asarray(labs)),
+                )
+
+    # donate=False: the non-finite guard keeps the PREVIOUS state when an
+    # update is discarded, which donated (invalidated) buffers cannot do
+    dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
+                      zero=False, donate=False)
+
+    # per-boundary preemption vote: OR this rank's flag across the world
+    # through a real collective, so every rank reaches the same stop
+    # verdict at the same step (see train_resumable's docstring)
+    _vote_sum = jax.jit(jnp.sum)
+
+    def agree_preempt(flag: bool) -> bool:
+        local = np.asarray([1.0 if flag else 0.0], np.float32)
+        return bool(int(_vote_sum(global_batch_from_local(mesh, local))) > 0)
+
+    restore_fn = None
+    save_fn = None
+    if args.ckpt_dir:
+        hc = HostCheckpoint(args.ckpt_dir)
+
+        def restore_fn():
+            res = hc.restore(template)
+            if res is None:
+                return None
+            host_state, meta = res
+            return dp.shard_state(host_state), meta
+
+        def save_fn(dstate, step, epoch, offset):
+            # single-writer: no collective, no barrier — still works while
+            # peer ranks are already dead (the reason orbax is not used here)
+            if rank == 0:
+                # host_view of a sharded leaf is this rank's block (BN stats
+                # carry a leading per-replica axis of 1); fold every leaf to
+                # the unsharded template's shape so save and restore agree
+                host = jax.tree.map(
+                    lambda h, t: np.asarray(h).reshape(np.shape(t)),
+                    dstate.host_view(), template,
+                )
+                hc.save(host, step, epoch=epoch, offset=offset)
+
+    gen = os.environ.get("TPU_SANDBOX_GENERATION", "1")
+    dstate = dp.shard_state(state)
+    try:
+        dstate, report = train_resumable(
+            dp.train_step, dstate, GlobalLoader(), args.epochs,
+            save_fn=save_fn, restore_fn=restore_fn,
+            ckpt_every=args.ckpt_every, preemption=preemption,
+            agree_fn=agree_preempt if world_size > 1 else None,
+            injector=injector, log_every=args.log_every, log_rank=rank,
+            verbose=rank == 0, set_epoch=False,
+        )
+        if rank == 0:
+            resumed = (f"resumed from step {report.resumed_step}"
+                       if report.resumed_step is not None else "fresh start")
+            print(f"[gen {gen}] {resumed}; applied {report.steps_applied} "
+                  f"step(s), final step {report.final_step}")
+        if save_fn is not None:
+            save_fn(dstate, report.final_step, args.epochs, 0)
+    except Preempted:
+        hb.stop(deregister=True)
+        bootstrap.cleanup()
+        sys.exit(PREEMPTED_EXIT_CODE)
+    except BaseException:
+        # a peer's preemption can surface here as a collective/dispatch
+        # error on this rank; if the preempt flag is up, classify this exit
+        # as preempted too so the supervisor's initiator-only rule holds
+        if preemption.requested():
+            hb.stop(deregister=True)
+            sys.exit(PREEMPTED_EXIT_CODE)
+        raise
+    finally:
+        preemption.uninstall()
+    bootstrap.cleanup()
+    hb.stop(deregister=True)
+
+
+def spawn_elastic(args, world_size):
+    """Run the multiprocess topology under the elastic supervisor: crashes
+    and preemptions tear the generation down and relaunch it; workers
+    resume from the newest valid checkpoint with exact data order."""
+    import os
+    import sys
+
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+    from tpu_sandbox.runtime.faults import FaultPlan
+    from tpu_sandbox.runtime.supervisor import (
+        RestartBudgetExceeded,
+        Supervisor,
+    )
+
+    try:
+        # fail fast here: a malformed plan would otherwise crash every
+        # worker at startup and silently burn the whole restart budget
+        FaultPlan.from_env()
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"invalid TPU_SANDBOX_FAULT_PLAN: {e}") from e
+
+    if args.zero:
+        # ZeRO shards optimizer state across processes; the rank-0-writes
+        # HostCheckpoint would silently drop every other rank's shard
+        raise SystemExit(
+            "--zero is not supported with --elastic yet: the elastic "
+            "checkpoint is written by rank 0 alone and would lose the "
+            "other ranks' optimizer-state shards"
+        )
+    if not args.ckpt_dir:
+        print("note: --elastic without --ckpt-dir restarts from step 0 "
+              "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
+
+    passthrough = [
+        "-n", str(args.nodes), "-g", str(args.gpus),
+        "--epochs", str(args.epochs), "--batch-size", str(args.batch_size),
+        "--image-size", str(args.image_size),
+        "--synthetic-n", str(args.synthetic_n),
+        "--log-every", str(args.log_every), "--dtype", args.dtype,
+        "--plan", args.plan, "--opt", args.opt,
+    ]
+    if args.data_dir:
+        passthrough += ["--data-dir", args.data_dir]
+    if args.limit_steps:
+        passthrough += ["--limit-steps", str(args.limit_steps)]
+    if args.ckpt_dir:
+        passthrough += ["--ckpt-dir", args.ckpt_dir]
+    if args.ckpt_every:
+        passthrough += ["--ckpt-every", str(args.ckpt_every)]
+
+    def build(gen, kv_port):
+        port = find_free_port()  # fresh coordinator port per generation
+        base = [sys.executable, __file__, "--elastic-worker",
+                "--port", port, "--kv-port", str(kv_port)] + passthrough
+        return [base + ["--rank", str(r)] for r in range(world_size)]
+
+    sup = Supervisor(
+        world_size, build,
+        max_restarts=args.max_restarts,
+        backoff=float(os.environ.get("TPU_SANDBOX_BACKOFF", 1.0)),
+        heartbeat_timeout=float(
+            os.environ.get("TPU_SANDBOX_WATCHDOG_TIMEOUT", 60.0)
+        ),
+        grace=float(os.environ.get("TPU_SANDBOX_WATCHDOG_GRACE", 180.0)),
+        term_timeout=float(
+            # how long a SIGTERM'd survivor (usually wedged in a collective
+            # whose peer died) gets before the SIGKILL escalation
+            os.environ.get("TPU_SANDBOX_TERM_TIMEOUT", 30.0)
+        ),
+    )
+    try:
+        result = sup.run()
+    except RestartBudgetExceeded as e:
+        raise SystemExit(str(e))
+    if not result.ok:
+        # preempted from outside: saved state, clean stop, propagate 75
+        sys.exit(result.generations[-1].exit_codes[0] or 0)
 
 
 def spawn_multiprocess(args, world_size):
@@ -345,7 +590,17 @@ def main():
     parser.add_argument("--multiprocess", action="store_true",
                         help="one OS process per rank over jax.distributed + "
                              "Gloo (the reference's actual topology)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run --multiprocess topology under the elastic "
+                             "supervisor: crashed/preempted generations are "
+                             "relaunched and resume from the newest "
+                             "checkpoint with exact data order")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="with --elastic: charged restarts before giving "
+                             "up (preemptions are free)")
     parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--elastic-worker", action="store_true",
+                        help=argparse.SUPPRESS)
     parser.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
     parser.add_argument("--port", type=str, default="", help=argparse.SUPPRESS)
     parser.add_argument("--kv-port", type=str, default="",
@@ -354,6 +609,10 @@ def main():
     world_size = args.gpus * args.nodes  # reference :123
     if args.worker:
         train_multiprocess_worker(args, world_size)
+    elif args.elastic_worker:
+        train_elastic_worker(args, world_size)
+    elif args.elastic:
+        spawn_elastic(args, world_size)
     elif args.multiprocess:
         spawn_multiprocess(args, world_size)
     else:

@@ -23,7 +23,12 @@ import jax  # noqa: E402
 # sets JAX_PLATFORMS before conftest runs); backend init is lazy, so flipping
 # the config here still forces CPU as long as no backend has initialized.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5): no such option; the XLA_FLAGS env set above is the
+    # only way to size the host platform, and it already asks for 8
+    pass
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402

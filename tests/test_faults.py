@@ -1,0 +1,110 @@
+"""Fault-injection harness: plan round-trips, exactly-once claims, and the
+checkpoint corruptors the recovery tests rely on."""
+
+import signal
+
+import pytest
+
+from tpu_sandbox.runtime.faults import (
+    ENV_PLAN,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    corrupt_latest_step,
+    corrupt_step_dir,
+)
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+
+def test_fault_validation():
+    with pytest.raises(ValueError, match="unknown fault action"):
+        Fault(0, 1, "explode")
+    with pytest.raises(ValueError, match="needs target"):
+        Fault(0, 1, "corrupt_ckpt")
+    Fault(0, 1, "corrupt_ckpt", target="/tmp/ck")  # ok with target
+
+
+def test_plan_json_and_env_round_trip():
+    plan = (FaultPlan()
+            .add(1, 7, "kill")
+            .add(0, 4, "sigterm")
+            .add(0, 9, "corrupt_ckpt", target="/tmp/ck"))
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.faults == plan.faults
+
+    env = plan.to_env({})
+    assert ENV_PLAN in env
+    assert FaultPlan.from_env(env).faults == plan.faults
+    # unset env var -> empty plan, not an error
+    assert FaultPlan.from_env({}).faults == []
+
+
+def test_injector_fires_only_matching_rank_and_step():
+    fired = []
+    plan = FaultPlan().add(1, 3, "hang_heartbeat")
+    inj = FaultInjector(plan, rank=1, on_hang_heartbeat=lambda: fired.append(1))
+    assert inj.maybe_fire(2) == []
+    other = FaultInjector(plan, rank=0, on_hang_heartbeat=lambda: fired.append(0))
+    assert other.maybe_fire(3) == []  # wrong rank
+    assert [f.action for f in inj.maybe_fire(3)] == ["hang_heartbeat"]
+    assert fired == [1]
+    assert inj.maybe_fire(3) == []  # local claim: never twice
+
+
+def test_claim_is_exactly_once_across_injectors():
+    """Two injectors sharing the store model a worker before and after an
+    elastic restart replaying the same step: the fault fires once."""
+    plan = FaultPlan().add(0, 5, "hang_heartbeat")
+    fired = []
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        first = FaultInjector(plan, 0, kv,
+                              on_hang_heartbeat=lambda: fired.append("a"))
+        second = FaultInjector(plan, 0, kv,
+                               on_hang_heartbeat=lambda: fired.append("b"))
+        assert len(first.maybe_fire(5)) == 1
+        assert second.maybe_fire(5) == []  # claimed in the store
+        assert fired == ["a"]
+        kv.close()
+
+
+def test_sigterm_fault_delivers_signal():
+    plan = FaultPlan().add(0, 1, "sigterm")
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        FaultInjector(plan, 0).maybe_fire(1)
+        assert got == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_corrupt_step_dir_keeps_layout(tmp_path):
+    d = tmp_path / "7" / "params"
+    d.mkdir(parents=True)
+    f = d / "data.bin"
+    f.write_bytes(b"precious")
+    touched = corrupt_step_dir(tmp_path / "7")
+    assert touched == [f]
+    assert f.exists() and f.read_bytes() != b"precious"
+
+
+def test_corrupt_latest_step_orbax_layout(tmp_path):
+    (tmp_path / "3").mkdir()
+    (tmp_path / "10").mkdir()
+    (tmp_path / "10" / "x.bin").write_bytes(b"good")
+    assert corrupt_latest_step(tmp_path) == tmp_path / "10"
+    assert (tmp_path / "10" / "x.bin").read_bytes() != b"good"
+
+
+def test_corrupt_latest_step_npz_layout(tmp_path):
+    (tmp_path / "step-2.npz").write_bytes(b"aaaa")
+    (tmp_path / "step-10.npz").write_bytes(b"bbbb")
+    # numeric order: step-10 is the newest, despite sorting after step-2
+    # lexicographically
+    assert corrupt_latest_step(tmp_path) == tmp_path / "step-10.npz"
+    assert (tmp_path / "step-2.npz").read_bytes() == b"aaaa"
+    assert corrupt_latest_step(tmp_path / "missing") is None
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert corrupt_latest_step(empty) is None

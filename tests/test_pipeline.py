@@ -246,9 +246,17 @@ def test_pipeline_4d_matches_dense_reference():
     import sys
 
     script = """
+import os
+os.environ['XLA_FLAGS'] = ' '.join(
+    [f for f in os.environ.get('XLA_FLAGS', '').split()
+     if 'xla_force_host_platform_device_count' not in f]
+    + ['--xla_force_host_platform_device_count=16'])
 import jax
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 16)
+try:
+    jax.config.update('jax_num_cpu_devices', 16)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS env above already sizes the host platform
 import jax.numpy as jnp, numpy as np, optax
 from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
 from tpu_sandbox.ops.losses import cross_entropy_loss

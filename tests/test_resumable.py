@@ -1,0 +1,301 @@
+"""train_resumable: exact-data-order resume, preemption (SIGTERM →
+save → Preempted), the non-finite guard, and the HostCheckpoint backend —
+all single-process, all tier-1 fast.
+
+The state here is a toy linear model (pure pytree), not the ConvNet: every
+property under test lives in the loop/checkpoint machinery, and the toy
+keeps each case sub-second.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_sandbox.runtime.faults import FaultInjector, FaultPlan
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.train.checkpoint import HostCheckpoint
+from tpu_sandbox.train.trainer import (
+    AbortOnAnomaly,
+    Preempted,
+    PreemptionHandler,
+    train_resumable,
+)
+
+
+# -- toy model: w <- w - lr * grad(mse(w.x, y)) -----------------------------
+
+def make_batches(n_batches=8, bs=4, dim=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(dim,)).astype(np.float32)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(bs, dim)).astype(np.float32)
+        y = (x @ w_true).astype(np.float32)
+        batches.append((x, y))
+    return batches
+
+
+class Loader:
+    """Deterministic loader that records what it hands out, so tests can
+    assert the exact global consumption order across crash+resume."""
+
+    def __init__(self, batches, log=None):
+        self.batches = batches
+        self.log = log if log is not None else []
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        for i, b in enumerate(self.batches):
+            self.log.append(i)
+            yield b
+
+
+@jax.jit
+def sgd_step(state, x, y):
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+
+    loss, g = jax.value_and_grad(loss_fn)(state["w"])
+    return {"w": state["w"] - 0.05 * g}, loss
+
+
+def fresh_state():
+    return {"w": jnp.zeros(3, jnp.float32)}
+
+
+def hc_fns(tmp_path):
+    hc = HostCheckpoint(tmp_path)
+    template = jax.tree.map(np.asarray, fresh_state())
+
+    def save_fn(state, step, epoch, offset):
+        hc.save(jax.tree.map(np.asarray, state), step,
+                epoch=epoch, offset=offset)
+
+    def restore_fn():
+        res = hc.restore(template)
+        if res is None:
+            return None
+        state, meta = res
+        return jax.tree.map(jnp.asarray, state), meta
+
+    return hc, save_fn, restore_fn
+
+
+class PreemptAt:
+    """Injector stub: flip the (programmatic) preemption flag at a step."""
+
+    def __init__(self, handler, step):
+        self.handler = handler
+        self.step = step
+
+    def maybe_fire(self, step):
+        if step == self.step:
+            self.handler.preempt_now()
+
+
+def test_uninterrupted_run_applies_every_batch():
+    batches = make_batches()
+    state, report = train_resumable(
+        sgd_step, fresh_state(), Loader(batches), 2, verbose=False
+    )
+    assert report.steps_applied == 2 * len(batches)
+    assert report.final_step == 2 * len(batches)
+    assert report.resumed_step is None and report.skipped_nonfinite == 0
+
+
+def recording_step(batches, seq):
+    """Wrap sgd_step to append the *applied* batch's index — the loader may
+    fetch-and-skip during resume; only batches that reach the step count."""
+    ids = {id(x): i for i, (x, _) in enumerate(batches)}
+
+    def step(state, x, y):
+        seq.append(ids[id(x)])
+        return sgd_step(state, x, y)
+
+    return step
+
+
+@pytest.mark.parametrize("preempt_step", [3, 8, 11])
+def test_preempt_resume_parity(tmp_path, preempt_step):
+    """Kill-and-resume must equal the uninterrupted run: same final
+    weights, every batch stepped exactly once, in the same order."""
+    batches = make_batches()
+    ref_seq = []
+    ref_state, _ = train_resumable(
+        recording_step(batches, ref_seq), fresh_state(), Loader(batches), 2,
+        verbose=False,
+    )
+
+    _, save_fn, restore_fn = hc_fns(tmp_path)
+    seq = []
+    handler = PreemptionHandler()
+    with pytest.raises(Preempted) as exc:
+        train_resumable(
+            recording_step(batches, seq), fresh_state(), Loader(batches), 2,
+            save_fn=save_fn, restore_fn=restore_fn, ckpt_every=2,
+            preemption=handler, injector=PreemptAt(handler, preempt_step),
+            verbose=False,
+        )
+    assert exc.value.step == preempt_step
+    assert len(seq) == preempt_step  # nothing stepped past the boundary
+
+    # "restarted process": fresh loop, restore from disk
+    state, report = train_resumable(
+        recording_step(batches, seq), fresh_state(), Loader(batches), 2,
+        save_fn=save_fn, restore_fn=restore_fn, ckpt_every=2,
+        preemption=PreemptionHandler(), verbose=False,
+    )
+    assert report.resumed_step == preempt_step
+    assert report.final_step == 2 * len(batches)
+    assert report.steps_applied == 2 * len(batches) - preempt_step
+    np.testing.assert_array_equal(
+        np.asarray(state["w"]), np.asarray(ref_state["w"])
+    )
+    # no batch replayed, none skipped: crash+resume sequence == reference
+    assert seq == ref_seq
+
+
+def test_sigterm_via_fault_injector_saves_and_preempts(tmp_path):
+    """The real signal path: a planned SIGTERM at step 3 → the handler
+    flags it, the in-flight step finishes, the state is saved, Preempted
+    escapes — and the checkpoint on disk is step 3's."""
+    batches = make_batches()
+    hc, save_fn, restore_fn = hc_fns(tmp_path)
+    handler = PreemptionHandler().install()
+    try:
+        injector = FaultInjector(FaultPlan().add(0, 3, "sigterm"), 0)
+        with pytest.raises(Preempted):
+            train_resumable(
+                sgd_step, fresh_state(), Loader(batches), 2,
+                save_fn=save_fn, restore_fn=restore_fn, ckpt_every=100,
+                preemption=handler, injector=injector, verbose=False,
+            )
+    finally:
+        handler.uninstall()
+    assert hc.latest_step() == 3
+    _, meta = hc.restore(jax.tree.map(np.asarray, fresh_state()))
+    assert (meta["step"], meta["epoch"], meta["offset"]) == (3, 0, 3)
+
+
+def test_nonfinite_step_is_skipped_keeping_state():
+    batches = make_batches(n_batches=6)
+    poisoned = list(batches)
+    x, y = poisoned[2]
+    poisoned[2] = (x, np.full_like(y, np.nan))  # loss -> nan
+
+    state, report = train_resumable(
+        sgd_step, fresh_state(), Loader(poisoned), 1,
+        max_bad_steps=3, verbose=False,
+    )
+    assert report.skipped_nonfinite == 1
+    assert report.steps_applied == 5
+    assert report.final_step == 5
+
+    # the skipped batch must not have moved the weights: replaying only the
+    # good batches reproduces the final state exactly
+    clean_state, _ = train_resumable(
+        sgd_step, fresh_state(),
+        Loader([b for i, b in enumerate(poisoned) if i != 2]), 1,
+        verbose=False,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state["w"]), np.asarray(clean_state["w"])
+    )
+
+
+def test_nonfinite_streak_aborts():
+    batches = make_batches(n_batches=6)
+    bad = [(x, np.full_like(y, np.nan)) for x, y in batches]
+    with pytest.raises(AbortOnAnomaly, match="3 consecutive"):
+        train_resumable(
+            sgd_step, fresh_state(), Loader(bad), 1,
+            max_bad_steps=3, verbose=False,
+        )
+
+
+def test_preemption_propagates_through_kv():
+    """Rank A receives the signal; rank B (never signaled) learns about it
+    from the store and stops at the same boundary."""
+    with KVServer() as srv:
+        a = PreemptionHandler(KVClient(port=srv.port))
+        b = PreemptionHandler(KVClient(port=srv.port))
+        assert not b.requested()
+        a.preempt_now()
+        assert a.requested()  # announces to the store as a side effect
+        assert b.requested()
+        a.kv.close()
+        b.kv.close()
+
+
+def test_preemption_handler_signal_sets_flag_only():
+    import os
+    import signal
+
+    handler = PreemptionHandler().install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.requested()
+    finally:
+        handler.uninstall()
+
+
+# -- HostCheckpoint backend -------------------------------------------------
+
+def _tree(v):
+    return {
+        "w": np.full((3, 2), v, np.float32),
+        "nested": {"b": np.arange(4, dtype=np.int32) + int(v)},
+    }
+
+
+def test_host_checkpoint_roundtrip_and_prune(tmp_path):
+    hc = HostCheckpoint(tmp_path, keep=2)
+    for step in (1, 2, 3):
+        hc.save(_tree(step), step, epoch=0, offset=step)
+    assert hc.steps() == [2, 3]  # keep=2 pruned step 1
+    state, meta = hc.restore(_tree(0))
+    assert meta == {"step": 3, "epoch": 0, "offset": 3, "dtypes": {}}
+    np.testing.assert_array_equal(state["w"], _tree(3)["w"])
+    np.testing.assert_array_equal(state["nested"]["b"], _tree(3)["nested"]["b"])
+
+
+def test_host_checkpoint_bf16_exact_roundtrip(tmp_path):
+    hc = HostCheckpoint(tmp_path)
+    state = {"p": np.asarray(jnp.arange(8, dtype=jnp.bfloat16) / 3)}
+    hc.save(state, 1, epoch=0, offset=1)
+    restored, meta = hc.restore({"p": np.zeros(8, state["p"].dtype)})
+    assert restored["p"].dtype == state["p"].dtype
+    np.testing.assert_array_equal(
+        restored["p"].astype(np.float32), state["p"].astype(np.float32)
+    )
+    assert meta["dtypes"] == {"p": "bfloat16"}
+
+
+def test_host_checkpoint_corrupt_falls_back(tmp_path, capsys):
+    hc = HostCheckpoint(tmp_path)
+    hc.save(_tree(1), 1, epoch=0, offset=1)
+    hc.save(_tree(2), 2, epoch=0, offset=2)
+    # scribble over the newest file (fault injection does exactly this)
+    newest = sorted(tmp_path.glob("step-*.npz"))[-1]
+    newest.write_bytes(b"\xde\xad not a zipfile")
+    state, meta = hc.restore(_tree(0))
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(state["w"], _tree(1)["w"])
+    # broken file quarantined aside, not deleted
+    assert list(tmp_path.glob("*.corrupt")), "corrupt file must be kept aside"
+    assert "quarantined" in capsys.readouterr().out
+
+
+def test_host_checkpoint_empty_and_shape_mismatch(tmp_path):
+    hc = HostCheckpoint(tmp_path)
+    assert hc.restore(_tree(0)) is None  # fresh start
+    hc.save(_tree(1), 1, epoch=0, offset=1)
+    bad_template = {"w": np.zeros((9, 9), np.float32),
+                    "nested": {"b": np.zeros(4, np.int32)}}
+    # explicit step: strict fail-loud
+    with pytest.raises(ValueError, match="shape"):
+        hc.restore(bad_template, step=1)

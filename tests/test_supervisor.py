@@ -1,0 +1,208 @@
+"""Elastic supervisor: detect → teardown → relaunch, with the budget and
+classification rules. Workers here are tiny ``python -c`` scripts (no jax)
+so every case runs in seconds inside tier-1.
+
+The real-training variants (kill a rank mid-epoch, resume, loss parity)
+live in test_elastic_integration.py, marked slow.
+"""
+
+import os
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.supervisor import (
+    PREEMPTED_EXIT_CODE,
+    PREEMPT_KEY,
+    RestartBudgetExceeded,
+    Supervisor,
+)
+
+
+def test_constants_mirror_trainer():
+    """supervisor.py and trainer.py deliberately do not import each other;
+    this pin is what keeps their shared constants from drifting."""
+    from tpu_sandbox.train import trainer
+
+    assert trainer.PREEMPTED_EXIT_CODE == PREEMPTED_EXIT_CODE
+    assert trainer.PREEMPT_KEY == PREEMPT_KEY
+
+
+# workers must import tpu_sandbox no matter where pytest was launched from
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+_EXTRA_ENV = {
+    "PYTHONPATH": _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+}
+
+
+def _worker(body: str) -> list[str]:
+    """A rank as a self-contained python -c script."""
+    return [sys.executable, "-c", textwrap.dedent(body)]
+
+
+def _exit_with(code: int) -> list[str]:
+    return _worker(f"import sys; sys.exit({code})")
+
+
+def _beating_worker(rank: int, body: str) -> list[str]:
+    """A rank that heartbeats into the supervisor's store, then runs body."""
+    return _worker(f"""
+        import os, sys, time
+        from tpu_sandbox.runtime.kvstore import KVClient
+        from tpu_sandbox.runtime.watchdog import Heartbeat
+        kv = KVClient(port=int(os.environ["TPU_SANDBOX_KV_PORT"]))
+        hb = Heartbeat(kv, {rank}, interval=0.05).start()
+        {body}
+    """)
+
+
+def test_clean_generation_is_ok():
+    sup = Supervisor(
+        2, lambda gen, port: [_exit_with(0), _exit_with(0)],
+        backoff=0.05, poll=0.02, verbose=False, extra_env=_EXTRA_ENV,
+    )
+    result = sup.run()
+    assert result.ok
+    assert result.restarts_charged == 0 and result.preemptions == 0
+    assert [g.outcome for g in result.generations] == ["ok"]
+
+
+def test_crash_restarts_and_recovers():
+    """Generation 1: rank 1 dies. Generation 2: everyone behaves. The
+    supervisor must tear down the survivor, charge one restart, relaunch."""
+    def build(gen, port):
+        if gen == 1:
+            return [_worker("import time; time.sleep(30)"), _exit_with(1)]
+        return [_exit_with(0), _exit_with(0)]
+
+    sup = Supervisor(2, build, backoff=0.05, poll=0.02,
+                     term_timeout=5.0, verbose=False, extra_env=_EXTRA_ENV)
+    result = sup.run()
+    assert result.ok
+    assert result.restarts_charged == 1
+    gens = result.generations
+    assert [g.outcome for g in gens] == ["failure", "ok"]
+    assert gens[0].culprits == [1]  # the initiator, not the torn-down peer
+    assert gens[0].exit_codes[1] == 1
+
+
+def test_restart_budget_exceeded():
+    sup = Supervisor(
+        1, lambda gen, port: [_exit_with(3)],
+        max_restarts=2, backoff=0.02, poll=0.02, verbose=False, extra_env=_EXTRA_ENV,
+    )
+    with pytest.raises(RestartBudgetExceeded, match="restart budget"):
+        sup.run()
+    # the exception carries the history: 3 failed generations, budget spent
+    try:
+        sup = Supervisor(1, lambda gen, port: [_exit_with(3)],
+                         max_restarts=1, backoff=0.02, poll=0.02,
+                         verbose=False, extra_env=_EXTRA_ENV)
+        sup.run()
+    except RestartBudgetExceeded as e:
+        assert len(e.result.generations) == 2
+        assert all(g.outcome == "failure" for g in e.result.generations)
+        assert e.result.restarts_charged == 2
+
+
+def test_preemption_not_charged():
+    """Exit 75 = "saved, restart me for free": no restart charged, prompt
+    relaunch, and the run still ends ok."""
+    def build(gen, port):
+        if gen == 1:
+            return [_exit_with(PREEMPTED_EXIT_CODE),
+                    _exit_with(PREEMPTED_EXIT_CODE)]
+        return [_exit_with(0), _exit_with(0)]
+
+    sup = Supervisor(2, build, max_restarts=0, backoff=0.05, poll=0.02,
+                     verbose=False, extra_env=_EXTRA_ENV)
+    result = sup.run()  # max_restarts=0: any charged restart would raise
+    assert result.ok
+    assert result.preemptions == 1 and result.restarts_charged == 0
+    assert [g.outcome for g in result.generations] == ["preemption", "ok"]
+
+
+def test_preemption_initiator_only_classification():
+    """Rank 0 exits preempted; rank 1 is blocked (a peer in a dead
+    collective) and only dies to the supervisor's own SIGTERM. The
+    teardown-produced code must not turn the preemption into a failure."""
+    def build(gen, port):
+        if gen == 1:
+            return [
+                _exit_with(PREEMPTED_EXIT_CODE),
+                _worker("import time\ntime.sleep(60)"),  # ignores nothing, but dies to SIGTERM
+            ]
+        return [_exit_with(0), _exit_with(0)]
+
+    sup = Supervisor(2, build, max_restarts=0, backoff=0.05, poll=0.02,
+                     term_timeout=5.0, verbose=False, extra_env=_EXTRA_ENV)
+    result = sup.run()
+    assert result.ok
+    assert result.preemptions == 1 and result.restarts_charged == 0
+    assert result.generations[0].culprits == [0]
+
+
+def test_wedged_rank_detected_by_watchdog():
+    """A rank that stops heartbeating but never exits can only be caught by
+    the heartbeat plane; exit-code polling would wait forever."""
+    def build(gen, port):
+        if gen == 1:
+            return [
+                # beats once (synchronously, via start()), then goes silent
+                # while staying alive
+                _beating_worker(0, "hb.stop(); time.sleep(60)"),
+            ]
+        return [_exit_with(0)]
+
+    sup = Supervisor(1, build, heartbeat_timeout=0.6, grace=2.0,
+                     backoff=0.05, poll=0.05, term_timeout=5.0,
+                     verbose=False, extra_env=_EXTRA_ENV)
+    result = sup.run()
+    assert result.ok
+    assert [g.outcome for g in result.generations] == ["wedged", "ok"]
+    assert result.restarts_charged == 1
+
+
+def test_health_plane_reset_between_generations():
+    """Generation 2 must not inherit generation 1's frozen heartbeat or
+    rendezvous keys — stale state would read as instant death / satisfied
+    rendezvous. Also: the preempt flag must be cleared."""
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        # poison the plane the way a dead generation would
+        kv.set("hb/0", b"123.0")
+        kv.set("rendezvous/gen/0", b"1")
+        kv.set(PREEMPT_KEY, b"1")
+
+        sup = Supervisor(
+            1, lambda gen, port: [_exit_with(0)],
+            backoff=0.05, poll=0.02, heartbeat_timeout=0.5, grace=5.0,
+            kv_server=srv, verbose=False, extra_env=_EXTRA_ENV,
+        )
+        result = sup.run()
+        assert result.ok  # frozen hb/0 stamp did not read as a dead rank
+        assert kv.try_get(PREEMPT_KEY) is None
+        assert kv.try_get("rendezvous/gen/0") is None
+        kv.close()
+
+
+def test_worker_env_carries_kv_port_and_generation():
+    """Workers learn the store and their generation from the env."""
+    probe = _worker("""
+        import os, sys
+        from tpu_sandbox.runtime.kvstore import KVClient
+        kv = KVClient(port=int(os.environ["TPU_SANDBOX_KV_PORT"]))
+        kv.set("probe/gen", os.environ["TPU_SANDBOX_GENERATION"].encode())
+        sys.exit(0)
+    """)
+    with KVServer() as srv:
+        sup = Supervisor(1, lambda gen, port: [probe],
+                         kv_server=srv, backoff=0.05, poll=0.02,
+                         verbose=False, extra_env=_EXTRA_ENV)
+        assert sup.run().ok
+        kv = KVClient(port=srv.port)
+        assert kv.try_get("probe/gen") == b"1"
+        kv.close()

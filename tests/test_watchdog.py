@@ -162,6 +162,111 @@ def test_heartbeat_restart(store):
     hb.stop()
 
 
+def test_watchdog_rank_appearing_late_but_within_grace(store):
+    """A slow-starting rank must not be declared dead: no stamp at all is
+    tolerated for the full grace window, and the first beat clears it."""
+    wd = Watchdog(store(), world_size=1, timeout=5.0, grace=0.6)
+    assert wd.dead_ranks() == []  # nothing yet: inside grace
+    time.sleep(0.2)
+    hb = Heartbeat(store(), 0, interval=0.05).start()  # appears late
+    time.sleep(0.6)  # well past the grace deadline
+    assert wd.dead_ranks() == []  # but it's beating now
+    hb.stop()
+
+
+def test_watchdog_rank_appearing_after_grace(store):
+    """Grace expiry without a stamp = dead; a rank that then *does* appear
+    flips back to alive (elastic rejoin), with death re-judged from its
+    stamp ages, not the stale grace verdict."""
+    wd = Watchdog(store(), world_size=1, timeout=5.0, grace=0.2)
+    time.sleep(0.3)
+    assert wd.dead_ranks() == [0]  # never appeared, grace spent
+    hb = Heartbeat(store(), 0, interval=0.05).start()
+    assert wd.dead_ranks() == []  # late joiner is alive again
+    hb.stop()
+
+
+def test_heartbeat_deregister_races_watchdog_check(store):
+    """Heartbeat.stop(deregister=True) deletes the hb key while a watchdog
+    may be mid-check: both sides must stay exception-free, and the final
+    verdict must be 'gone' (key deleted reads as never-appeared)."""
+    import threading
+
+    wd = Watchdog(store(), world_size=1, timeout=5.0, grace=0.0)
+    errs = []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                wd.check()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for r in range(5):  # repeated register/deregister under fire
+            hb = Heartbeat(store(), 0, interval=0.02).start()
+            time.sleep(0.05)
+            hb.stop(deregister=True)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert not errs
+    # grace=0.0 and the key deleted: the rank reads as dead, not lingering
+    assert wd.dead_ranks() == [0]
+
+
+# --- KV client connect retry ----------------------------------------------
+
+def test_kvclient_retries_until_server_appears():
+    """Worker processes race rank 0's server startup; the client must
+    retry-connect inside its timeout instead of dying on the first RST."""
+    import threading
+
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+
+    port = int(find_free_port())
+    box = {}
+
+    def late_server():
+        time.sleep(0.4)
+        box["srv"] = KVServer(port=port)
+
+    t = threading.Thread(target=late_server)
+    t.start()
+    try:
+        c = KVClient(port=port, connect_timeout=10.0)  # server not up yet
+        c.set("k", b"v")
+        assert c.try_get("k") == b"v"
+        c.close()
+    finally:
+        t.join(timeout=5)
+        if "srv" in box:
+            box["srv"].stop()
+
+
+def test_kvclient_connect_timeout_exhausted_raises():
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+
+    port = int(find_free_port())  # nothing will ever listen here
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="retried"):
+        KVClient(port=port, connect_timeout=0.4)
+    assert time.monotonic() - t0 < 5.0  # bounded, not hanging
+
+
+def test_kvclient_single_attempt_mode():
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+
+    port = int(find_free_port())
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        KVClient(port=port, connect_timeout=0)
+    assert time.monotonic() - t0 < 1.0  # no retry loop at all
+
+
 def test_guarded_step_catches_blowup():
     from tpu_sandbox.utils.debugging import NonFiniteError, guarded_step
 

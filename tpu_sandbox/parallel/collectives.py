@@ -21,6 +21,8 @@ from __future__ import annotations
 from functools import cached_property, partial
 
 import jax
+
+from tpu_sandbox.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -70,7 +72,7 @@ class CollectiveGroup:
         # (all_gather/broadcast) but jax's varying-mesh-axes analysis can't
         # statically see it.
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 f,
                 mesh=self.mesh,
                 in_specs=P(self.axis),
@@ -144,7 +146,7 @@ class CollectiveGroup:
             return lax.dynamic_index_in_dim(full, root, axis=0, keepdims=False)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(P(self.axis), P()),

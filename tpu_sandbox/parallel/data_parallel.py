@@ -27,6 +27,8 @@ from functools import partial
 from typing import Callable
 
 import jax
+
+from tpu_sandbox.utils.compat import shard_map
 import jax.numpy as jnp
 import optax
 from jax import lax
@@ -272,7 +274,7 @@ class DataParallel:
 
     def _compile_for(self, state: TrainState) -> Callable:
         specs = self._specs(state)
-        smapped = jax.shard_map(
+        smapped = shard_map(
             self._shard_body,
             mesh=self.mesh,
             in_specs=(specs, P(self.axis), P(self.axis)),

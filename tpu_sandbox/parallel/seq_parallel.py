@@ -21,6 +21,8 @@ from functools import partial
 from typing import Callable
 
 import jax
+
+from tpu_sandbox.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -126,7 +128,7 @@ class SeqParallel:
 
         batch_spec = P(daxis, saxis)
         state_spec = TrainState(step=P(), params=P(), batch_stats=P(), opt_state=P())
-        smapped = jax.shard_map(
+        smapped = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(state_spec, batch_spec, batch_spec, batch_spec),

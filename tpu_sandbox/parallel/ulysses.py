@@ -29,6 +29,8 @@ from __future__ import annotations
 from functools import partial
 
 import jax.numpy as jnp
+
+from tpu_sandbox.utils.compat import axis_size, shard_map
 from jax import lax
 from jax.sharding import Mesh
 
@@ -53,7 +55,7 @@ def ulysses_attention(
     exact-parity tests only; VERDICT r01 weak #6 flagged dense-by-default
     as contradicting SP's purpose).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[2]
     if h % n:
         raise ValueError(
@@ -91,7 +93,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str, *, causal: bool = True,
 
     if axis not in mesh.axis_names:
         raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ulysses_attention, axis_name=axis, causal=causal, impl=impl),
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),

@@ -10,7 +10,19 @@ from tpu_sandbox.runtime.bootstrap import (  # noqa: F401
     topology,
     topology_summary,
 )
+from tpu_sandbox.runtime.faults import (  # noqa: F401
+    Fault,
+    FaultInjector,
+    FaultPlan,
+)
 from tpu_sandbox.runtime.mesh import make_mesh, submesh  # noqa: F401
+from tpu_sandbox.runtime.supervisor import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    ElasticResult,
+    GenerationReport,
+    RestartBudgetExceeded,
+    Supervisor,
+)
 from tpu_sandbox.runtime.watchdog import (  # noqa: F401
     DeadRankError,
     Heartbeat,

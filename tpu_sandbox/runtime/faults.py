@@ -1,0 +1,202 @@
+"""Deterministic fault injection — the test half of the detect→recover loop.
+
+The supervisor (runtime/supervisor.py) can only be trusted if rank death,
+preemption, wedged ranks, and corrupt checkpoints are *reproducible*; this
+module makes failures first-class inputs instead of flaky sleeps-and-kills
+in tests:
+
+- ``FaultPlan``     — a declarative list of :class:`Fault` entries ("rank 1
+                      dies at optimizer step 7", "rank 0 receives SIGTERM at
+                      step 4", ...), serialized through one env var so it
+                      crosses the supervisor→worker process boundary.
+- ``FaultInjector`` — lives inside the worker's training loop; at every
+                      step boundary ``maybe_fire(step)`` fires any fault
+                      scheduled for (this rank, this step). Each fault is
+                      claimed through the shared KV store, so it fires
+                      exactly once *across elastic restarts* — replaying
+                      the same step after recovery must not re-kill the
+                      worker, or the job would crash-loop forever.
+
+Actions:
+
+``kill``            SIGKILL self — the hard crash the watchdog + supervisor
+                    must turn into a restart, not a hang.
+``sigterm``         SIGTERM self — models a TPU/spot preemption notice; the
+                    trainer's PreemptionHandler turns it into save+exit(75).
+``hang_heartbeat``  stop publishing heartbeats while the process keeps
+                    running — the wedged-not-dead case only the watchdog
+                    (never exit-code polling) can detect.
+``corrupt_ckpt``    scribble garbage over the newest checkpoint step under
+                    ``target`` — exercises restore's quarantine-and-fall-
+                    back path (checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Callable, Mapping, MutableMapping
+
+ENV_PLAN = "TPU_SANDBOX_FAULT_PLAN"
+
+ACTIONS = ("kill", "sigterm", "hang_heartbeat", "corrupt_ckpt")
+
+
+@dataclass(frozen=True)
+class Fault:
+    rank: int
+    step: int
+    action: str
+    target: str | None = None  # corrupt_ckpt: the checkpoint directory
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {self.action!r}; choose from {ACTIONS}"
+            )
+        if self.action == "corrupt_ckpt" and not self.target:
+            raise ValueError("corrupt_ckpt needs target=<checkpoint dir>")
+
+
+class FaultPlan:
+    """An ordered set of faults, round-trippable through one env var."""
+
+    def __init__(self, faults: list[Fault] | tuple[Fault, ...] = ()):
+        self.faults = list(faults)
+
+    def add(self, rank: int, step: int, action: str,
+            target: str | None = None) -> "FaultPlan":
+        self.faults.append(Fault(rank, step, action, target))
+        return self
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    def to_json(self) -> str:
+        return json.dumps([asdict(f) for f in self.faults])
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls([Fault(**d) for d in json.loads(text)])
+
+    def to_env(self, env: MutableMapping[str, str] | None = None) -> dict:
+        """Write the plan into ``env`` (default: a fresh copy of
+        ``os.environ``) and return that mapping — hand it to Popen."""
+        env = dict(os.environ) if env is None else env
+        env[ENV_PLAN] = self.to_json()
+        return env
+
+    @classmethod
+    def from_env(cls, environ: Mapping[str, str] | None = None) -> "FaultPlan":
+        """The plan the launcher serialized, or an empty plan (the normal,
+        fault-free run) when the env var is unset."""
+        raw = (environ or os.environ).get(ENV_PLAN, "")
+        return cls.from_json(raw) if raw else cls()
+
+
+class FaultInjector:
+    """Worker-side trigger. Call ``maybe_fire(step)`` at every optimizer-step
+    boundary; faults scheduled for (rank, step) fire at most once globally.
+
+    ``kv``: a KVClient sharing the supervisor's store. When present, each
+    fault is claimed with an atomic counter (``fault/<i>/claimed``) that
+    survives worker restarts — the claim, not the process, is what makes a
+    kill-at-step-7 happen once instead of on every replay of step 7.
+    Without a store (single-process tests) claims are process-local.
+
+    ``on_hang_heartbeat``: callback that silences this rank's liveness
+    publishing (wire it to ``Heartbeat.stop``); the process itself keeps
+    training, which is the point of that fault.
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        rank: int,
+        kv=None,
+        *,
+        on_hang_heartbeat: Callable[[], None] | None = None,
+    ):
+        self.plan = plan
+        self.rank = rank
+        self.kv = kv
+        self.on_hang_heartbeat = on_hang_heartbeat
+        self._claimed_local: set[int] = set()
+
+    def _claim(self, index: int) -> bool:
+        if index in self._claimed_local:
+            return False
+        self._claimed_local.add(index)
+        if self.kv is not None:
+            return self.kv.add(f"fault/{index}/claimed", 1) == 1
+        return True
+
+    def maybe_fire(self, step: int) -> list[Fault]:
+        """Fire this rank's faults scheduled exactly at ``step``; returns the
+        faults that fired (kill, of course, never returns)."""
+        fired = []
+        for i, f in enumerate(self.plan.faults):
+            if f.rank != self.rank or f.step != step:
+                continue
+            if not self._claim(i):
+                continue
+            self._fire(f)
+            fired.append(f)
+        return fired
+
+    def _fire(self, f: Fault) -> None:
+        if f.action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif f.action == "sigterm":
+            # handler (trainer.PreemptionHandler) runs at the next bytecode
+            # boundary; the in-flight step then finishes before save+exit
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif f.action == "hang_heartbeat":
+            if self.on_hang_heartbeat is not None:
+                self.on_hang_heartbeat()
+        elif f.action == "corrupt_ckpt":
+            corrupt_latest_step(f.target)
+
+
+# -- checkpoint corruption (also used directly by tests) -------------------
+
+def corrupt_step_dir(step_dir: str | os.PathLike) -> list[Path]:
+    """Overwrite every regular file under ``step_dir`` with garbage bytes
+    (keeping the layout, so the step still *looks* committed — the nastier
+    corruption mode). Returns the files touched."""
+    touched = []
+    for p in sorted(Path(step_dir).rglob("*")):
+        if p.is_file():
+            p.write_bytes(b"\xde\xad\xbe\xef garbage " * 4)
+            touched.append(p)
+    return touched
+
+
+def corrupt_latest_step(directory: str | os.PathLike) -> Path | None:
+    """Corrupt the newest committed checkpoint step under ``directory``.
+
+    Understands both on-disk layouts in this repo: orbax step directories
+    (numeric child dirs) and HostCheckpoint step files (``step-*.npz``).
+    Returns what was corrupted, or None when the dir holds no steps yet.
+    """
+    root = Path(directory)
+    if not root.is_dir():
+        return None
+    step_dirs = [p for p in root.iterdir() if p.is_dir() and p.name.isdigit()]
+    if step_dirs:
+        latest = max(step_dirs, key=lambda p: int(p.name))
+        corrupt_step_dir(latest)
+        return latest
+    npzs = [
+        p for p in root.glob("step-*.npz")
+        if p.stem.split("-", 1)[1].isdigit()
+    ]
+    if npzs:
+        # numeric, not lexicographic: step-10 is newer than step-2
+        latest = max(npzs, key=lambda p: int(p.stem.split("-", 1)[1]))
+        latest.write_bytes(b"\xde\xad\xbe\xef not a zipfile")
+        return latest
+    return None

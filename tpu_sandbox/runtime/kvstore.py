@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 
 
 def _lib() -> ctypes.CDLL:
@@ -65,12 +66,34 @@ class KVServer:
 
 
 class KVClient:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        connect_timeout: float = 10.0,
+    ):
+        """Connect with bounded retry: worker processes race the rank-0
+        server's listen() (an elastic restart relaunches everyone at once),
+        so a refused connection within ``connect_timeout`` seconds is
+        "server not up yet", not an error. ``connect_timeout=0`` restores
+        the old single-attempt behavior."""
         self._lib = _lib()
         self.host, self.port = host, port
-        self._fd = self._lib.kv_connect(host.encode(), port)
-        if self._fd < 0:
-            raise ConnectionError(f"kv_connect {host}:{port} failed")
+        self.connect_timeout = connect_timeout
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.02
+        while True:
+            self._fd = self._lib.kv_connect(host.encode(), port)
+            if self._fd >= 0:
+                break
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"kv_connect {host}:{port} failed "
+                    f"(retried for {connect_timeout}s)"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
         # one request-response in flight per connection: the wire protocol is
         # length-prefixed with no framing recovery, so concurrent callers
         # (e.g. a Heartbeat thread sharing the owner's client) must serialize

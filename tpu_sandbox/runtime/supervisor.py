@@ -1,0 +1,335 @@
+"""Elastic supervisor: close the detect→recover loop over worker processes.
+
+The repo already *detects* failure (runtime/watchdog.py heartbeats +
+bounded rendezvous) and can *resume* (train/checkpoint.py); until now
+nothing acted on a failure — a crashed rank still took the whole job down,
+exactly the reference's failure story (SURVEY §5). This module is the
+missing actor, following the elastic-agent design torchelastic
+popularized: a single supervisor process owns the KV store, spawns one
+worker process per rank, and monitors two independent signals —
+
+- **exit codes** (``Popen.poll``): crash, clean finish, or the distinct
+  "preempted" code below;
+- **heartbeats** (``Watchdog`` over the KV store): the wedged-not-dead
+  rank that exit codes can never see (alive as a process, silent for
+  ``heartbeat_timeout`` — e.g. stuck in a collective whose peer vanished).
+
+On any failure the whole *generation* is torn down (a survivor of a dead
+peer is blocked in a collective and useless) and relaunched after bounded
+exponential backoff, up to ``max_restarts`` charged restarts. A generation
+whose culprit ranks all exited with :data:`PREEMPTED_EXIT_CODE` — the code
+the trainer's SIGTERM handler uses after finishing its in-flight step and
+saving — is a *preemption*: it restarts promptly and does **not** charge
+the restart budget (preemption is the dominant real-world TPU failure and
+is not the job's fault). Workers re-join through ``wait_for_world``'s
+generation-scoped rendezvous; the supervisor clears the per-generation
+health keys so every generation starts from a clean plane.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Sequence
+
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.watchdog import Watchdog, _hb_key
+
+#: Exit code meaning "I was preempted: state is saved, restart me for free".
+#: 75 is sysexits' EX_TEMPFAIL — transient failure, retry is appropriate.
+PREEMPTED_EXIT_CODE = 75
+
+ENV_KV_PORT = "TPU_SANDBOX_KV_PORT"
+ENV_GENERATION = "TPU_SANDBOX_GENERATION"
+
+#: KV key a preempted rank sets so its peers stop at the same boundary.
+PREEMPT_KEY = "preempt/requested"
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The job kept dying after ``max_restarts`` charged restarts (or blew
+    through ``max_preemptions``); carries the full generation history."""
+
+    def __init__(self, msg: str, result: "ElasticResult"):
+        super().__init__(msg)
+        self.result = result
+
+
+@dataclass
+class GenerationReport:
+    generation: int
+    outcome: str  # "ok" | "failure" | "preemption" | "wedged"
+    exit_codes: list[int | None]
+    culprits: list[int]  # ranks that initiated the failure (pre-teardown)
+    elapsed: float
+
+
+@dataclass
+class ElasticResult:
+    world_size: int
+    generations: list[GenerationReport] = field(default_factory=list)
+    restarts_charged: int = 0
+    preemptions: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.generations) and self.generations[-1].outcome == "ok"
+
+    def summary(self) -> str:
+        gens = ", ".join(
+            f"gen{g.generation}:{g.outcome}" for g in self.generations
+        )
+        return (
+            f"{len(self.generations)} generation(s) [{gens}]; "
+            f"{self.restarts_charged} restart(s) charged, "
+            f"{self.preemptions} preemption(s)"
+        )
+
+
+class Supervisor:
+    """Spawn/monitor/relaunch one process per rank until the job finishes.
+
+    ``command_for_generation(generation, kv_port) -> list[argv]`` builds
+    the per-rank commands fresh for every generation (fresh coordinator
+    ports, ``--resume`` flags, ... live in the builder, which keeps this
+    class free of any training-specific knowledge). Each worker inherits
+    ``TPU_SANDBOX_KV_PORT`` and ``TPU_SANDBOX_GENERATION`` in its env on
+    top of ``os.environ`` and ``extra_env``.
+
+    A SIGTERM delivered to the supervisor itself (the whole job being
+    preempted) is forwarded to every worker; once the generation winds
+    down it is reported as a preemption and the supervisor stops
+    relaunching — the job's next incarnation resumes from the checkpoint.
+    """
+
+    def __init__(
+        self,
+        world_size: int,
+        command_for_generation: Callable[[int, int], Sequence[Sequence[str]]],
+        *,
+        max_restarts: int = 3,
+        max_preemptions: int = 32,
+        backoff: float = 1.0,
+        backoff_max: float = 30.0,
+        heartbeat_timeout: float = 60.0,
+        grace: float = 180.0,
+        poll: float = 0.1,
+        term_timeout: float = 30.0,
+        extra_env: Mapping[str, str] | None = None,
+        kv_server: KVServer | None = None,
+        verbose: bool = True,
+    ):
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        self.world_size = world_size
+        self.command_for_generation = command_for_generation
+        self.max_restarts = max_restarts
+        self.max_preemptions = max_preemptions
+        self.backoff = backoff
+        self.backoff_max = backoff_max
+        self.heartbeat_timeout = heartbeat_timeout
+        self.grace = grace
+        self.poll = poll
+        self.term_timeout = term_timeout
+        self.extra_env = dict(extra_env or {})
+        self._kv_server = kv_server
+        self._owns_server = kv_server is None
+        self.verbose = verbose
+        self._external_preempt = False
+        self._procs: list[subprocess.Popen] = []
+
+    # -- logging ----------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[supervisor] {msg}", flush=True)
+
+    # -- health-plane reset ------------------------------------------------
+
+    def _reset_health_plane(self, kv: KVClient) -> None:
+        """A new generation must not inherit the dead one's liveness or
+        rendezvous state: a frozen heartbeat stamp would read as an
+        immediately-dead rank, and unequal rendezvous counters (a rank that
+        died before joining) would wedge ``wait_for_world`` forever. Fault
+        claims are deliberately NOT cleared — a fault fires once per job,
+        not once per generation."""
+        for r in range(self.world_size):
+            kv.delete(_hb_key(r))
+            kv.delete(f"rendezvous/gen/{r}")
+        kv.delete(PREEMPT_KEY)
+
+    # -- teardown ----------------------------------------------------------
+
+    def _teardown(self, codes: list[int | None]) -> None:
+        """Stop every still-running worker: SIGTERM first (gives the
+        trainer's preemption handler a chance to save), SIGKILL stragglers
+        wedged in a native collective."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.term_timeout
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        for i, p in enumerate(self._procs):
+            if codes[i] is None:
+                codes[i] = p.poll()
+
+    # -- one generation ----------------------------------------------------
+
+    def _run_generation(self, gen: int, kv: KVClient, kv_port: int
+                        ) -> GenerationReport:
+        cmds = [list(c) for c in self.command_for_generation(gen, kv_port)]
+        if len(cmds) != self.world_size:
+            raise ValueError(
+                f"command_for_generation returned {len(cmds)} commands for "
+                f"world_size {self.world_size}"
+            )
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env[ENV_KV_PORT] = str(kv_port)
+        env[ENV_GENERATION] = str(gen)
+        start = time.monotonic()
+        self._procs = [subprocess.Popen(cmd, env=env) for cmd in cmds]
+        watchdog = Watchdog(
+            kv, self.world_size,
+            timeout=self.heartbeat_timeout, grace=self.grace,
+        )
+        codes: list[int | None] = [None] * self.world_size
+        try:
+            while any(c is None for c in codes):
+                for i, p in enumerate(self._procs):
+                    if codes[i] is None:
+                        codes[i] = p.poll()
+                culprits = [
+                    r for r, c in enumerate(codes) if c not in (None, 0)
+                ]
+                if culprits:
+                    # initiator-only classification: codes produced later by
+                    # our own teardown (SIGTERM/SIGKILL of blocked peers)
+                    # must not turn a preemption into a charged failure
+                    preempted = all(
+                        codes[r] == PREEMPTED_EXIT_CODE for r in culprits
+                    )
+                    self._teardown(codes)
+                    outcome = "preemption" if preempted else "failure"
+                    return GenerationReport(
+                        gen, outcome, codes, culprits,
+                        time.monotonic() - start,
+                    )
+                wedged = [r for r in watchdog.dead_ranks() if codes[r] is None]
+                if wedged:
+                    self._teardown(codes)
+                    return GenerationReport(
+                        gen, "wedged", codes, wedged,
+                        time.monotonic() - start,
+                    )
+                time.sleep(self.poll)
+        finally:
+            # belt and braces: never leak workers past a generation, even
+            # when the monitor loop itself raises (e.g. KeyboardInterrupt)
+            if any(p.poll() is None for p in self._procs):
+                self._teardown(codes)
+        return GenerationReport(
+            gen, "ok", codes, [], time.monotonic() - start
+        )
+
+    # -- the elastic loop --------------------------------------------------
+
+    def _install_forwarder(self):
+        """Forward a supervisor-level SIGTERM to the workers (whole-job
+        preemption). Returns the previous handler, restored by run()."""
+        def fwd(signum, frame):
+            self._external_preempt = True
+            for p in self._procs:
+                if p.poll() is None:
+                    try:
+                        p.terminate()
+                    except OSError:
+                        pass
+        try:
+            return signal.signal(signal.SIGTERM, fwd)
+        except ValueError:
+            return None  # not the main thread (tests); skip forwarding
+
+    def run(self) -> ElasticResult:
+        result = ElasticResult(self.world_size)
+        server = self._kv_server or KVServer()
+        kv = KVClient(port=server.port)
+        prev_handler = self._install_forwarder()
+        gen = 0
+        try:
+            while True:
+                gen += 1
+                self._reset_health_plane(kv)
+                kv.set("elastic/generation", str(gen))
+                self._log(
+                    f"generation {gen}: launching {self.world_size} worker(s)"
+                )
+                report = self._run_generation(gen, kv, server.port)
+                result.generations.append(report)
+                if report.outcome == "ok":
+                    self._log(f"done: {result.summary()}")
+                    return result
+                if report.outcome == "preemption":
+                    result.preemptions += 1
+                    if self._external_preempt:
+                        self._log(
+                            "preempted from outside; state saved — exiting "
+                            "without relaunch: " + result.summary()
+                        )
+                        return result
+                    if result.preemptions > self.max_preemptions:
+                        raise RestartBudgetExceeded(
+                            f"more than {self.max_preemptions} preemptions; "
+                            "refusing to thrash: " + result.summary(),
+                            result,
+                        )
+                    delay = self.backoff  # prompt, no exponential ramp
+                else:  # failure / wedged: charge the budget
+                    result.restarts_charged += 1
+                    if result.restarts_charged > self.max_restarts:
+                        raise RestartBudgetExceeded(
+                            f"rank(s) {report.culprits} {report.outcome} in "
+                            f"generation {gen} and the restart budget "
+                            f"({self.max_restarts}) is spent: "
+                            + result.summary(),
+                            result,
+                        )
+                    delay = min(
+                        self.backoff * (2 ** (result.restarts_charged - 1)),
+                        self.backoff_max,
+                    )
+                self._log(
+                    f"generation {gen} {report.outcome} "
+                    f"(culprit rank(s) {report.culprits}, exit codes "
+                    f"{report.exit_codes}); relaunching in {delay:.1f}s "
+                    f"[{result.restarts_charged}/{self.max_restarts} "
+                    f"restarts charged, {result.preemptions} preemption(s)]"
+                )
+                time.sleep(delay)
+        finally:
+            if prev_handler is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev_handler)
+                except ValueError:
+                    pass
+            kv.close()
+            if self._owns_server:
+                server.stop()
+
+
+def main_argv_for_rank(base: Sequence[str], rank: int) -> list[str]:
+    """Tiny helper for builders: ``base + ["--rank", str(rank)]``."""
+    return [*base, "--rank", str(rank)]

@@ -1,7 +1,13 @@
 from tpu_sandbox.train.state import TrainState  # noqa: F401
 from tpu_sandbox.train.trainer import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    AbortOnAnomaly,
+    Preempted,
+    PreemptionHandler,
+    ResumableReport,
     Trainer,
     make_train_step,
     prepare_inputs,
     resize_on_device,
+    train_resumable,
 )

@@ -12,10 +12,13 @@ leaves are sharded) target shardings.
 
 from __future__ import annotations
 
+import json
 import os
+import tempfile
 from pathlib import Path
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from tpu_sandbox.train.state import TrainState
@@ -101,8 +104,84 @@ def save(directory: str | os.PathLike, state: TrainState, step: int | None = Non
 
 
 def latest_step(directory: str | os.PathLike) -> int | None:
-    with _manager(directory, create=False) as mgr:
-        return mgr.latest_step()
+    """Newest step orbax knows about. Hardened: a directory whose listing
+    orbax cannot parse (stray junk dropped next to step dirs by a killed
+    worker) degrades to a manual scan of numeric child dirs instead of
+    crashing the restore path."""
+    try:
+        with _manager(directory, create=False) as mgr:
+            return mgr.latest_step()
+    except Exception:
+        steps = _numeric_steps(directory)
+        return max(steps) if steps else None
+
+
+def _numeric_steps(directory: str | os.PathLike) -> list[int]:
+    d = Path(directory)
+    if not d.is_dir():
+        return []
+    return sorted(
+        int(p.name) for p in d.iterdir() if p.is_dir() and p.name.isdigit()
+    )
+
+
+def quarantine_step(directory: str | os.PathLike, step: int) -> Path | None:
+    """Move a broken step directory into ``<directory>.quarantine/`` (next
+    to, never inside, the checkpoint dir — orbax must not rediscover it)
+    so restore can fall back to an older step. Concurrent quarantiners
+    (every rank restores at startup) race benignly: first rename wins,
+    the rest see ENOENT and move on. Returns the new location, or None
+    when someone else already moved it."""
+    src = Path(directory).absolute() / str(step)
+    qdir = src.parent.with_name(src.parent.name + ".quarantine")
+    qdir.mkdir(parents=True, exist_ok=True)
+    dst = qdir / src.name
+    n = 0
+    while dst.exists():  # same step quarantined twice across restarts
+        n += 1
+        dst = qdir / f"{src.name}.{n}"
+    try:
+        os.replace(src, dst)
+    except OSError:
+        return None
+    print(f"checkpoint step {step} is broken; quarantined to {dst}",
+          flush=True)
+    return dst
+
+
+# -- data-order sidecars ---------------------------------------------------
+#
+# Resume must replay no batch and skip none: alongside each checkpoint the
+# trainer records where the data stream stood (epoch, batch offset within
+# the epoch, optimizer step). Plain *files* in the checkpoint dir — orbax
+# step discovery and the layout guard both only look at directories.
+
+def save_data_state(
+    directory: str | os.PathLike, step: int, *, epoch: int, offset: int,
+    extra: dict | None = None,
+) -> Path:
+    d = Path(directory).absolute()
+    d.mkdir(parents=True, exist_ok=True)
+    payload = {"step": int(step), "epoch": int(epoch), "offset": int(offset)}
+    payload.update(extra or {})
+    final = d / f"data_state-{int(step)}.json"
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, final)  # atomic: a kill mid-save never leaves half a file
+    return final
+
+
+def load_data_state(
+    directory: str | os.PathLike, step: int
+) -> dict | None:
+    f = Path(directory).absolute() / f"data_state-{int(step)}.json"
+    if not f.exists():
+        return None
+    try:
+        return json.loads(f.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None  # sidecar corrupt: caller derives order from the step
 
 
 class AsyncSaver:
@@ -137,15 +216,198 @@ class AsyncSaver:
         self.close()
 
 
+# -- HostCheckpoint: coordination-free save/restore for elastic runs -------
+
+def _flatten_with_paths(tree) -> tuple[list[tuple[str, object]], object]:
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    def pstr(path):
+        return "/".join(
+            str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+            for p in path
+        )
+    return [(pstr(path), leaf) for path, leaf in leaves], treedef
+
+
+def _to_savable(arr: np.ndarray) -> tuple[np.ndarray, str | None]:
+    """npz can't hold the ml_dtypes (bfloat16/fp8: numpy kind 'V'); store
+    them widened to float32 and remember the original dtype name. Exact:
+    every bf16/fp8 value is representable in fp32."""
+    if arr.dtype.kind == "V":
+        return arr.astype(np.float32), arr.dtype.name
+    return arr, None
+
+
+def _from_savable(arr: np.ndarray, dtype_name: str | None) -> np.ndarray:
+    if dtype_name is None:
+        return arr
+    import ml_dtypes
+
+    return arr.astype(np.dtype(getattr(ml_dtypes, dtype_name)))
+
+
+class HostCheckpoint:
+    """Single-writer numpy checkpointing for multi-controller runs.
+
+    Orbax's multi-controller save is a *collective* (global barriers at
+    commit) — exactly what an elastic job cannot rely on, because ranks
+    die mid-save and a barrier with a dead peer never completes. This
+    class sidesteps the whole problem: rank 0 writes its host-local view
+    of the state (params/opt are replicated, so rank 0's copy is the
+    model; BN stats are rank 0's replica, the same single-device layout
+    ``DataParallel.unshard_state`` checkpoints) as one ``step-<n>.npz``
+    with an atomic rename, and every rank restores by reading that file —
+    no cross-process coordination anywhere on the save/restore path.
+
+    Restore validates the newest file by actually loading it; a truncated
+    or scribbled file (a worker killed mid-write can't produce one —
+    that's the tmp+rename — but fault injection and disk trouble can) is
+    renamed to ``*.corrupt`` and the next older step is used.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self.keep = keep
+
+    def _path(self, step: int) -> Path:
+        return self.directory / f"step-{int(step):08d}.npz"
+
+    def steps(self) -> list[int]:
+        if not self.directory.is_dir():
+            return []
+        out = []
+        for p in self.directory.glob("step-*.npz"):
+            try:
+                out.append(int(p.stem.split("-", 1)[1]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def save(self, local_state, step: int, *, epoch: int, offset: int,
+             extra: dict | None = None) -> Path:
+        """``local_state``: a fully host-addressable view (see
+        ``TrainState.host_view``). Atomic: concurrent readers only ever
+        see complete files."""
+        self.directory.mkdir(parents=True, exist_ok=True)
+        leaves, _ = _flatten_with_paths(local_state)
+        arrays: dict[str, np.ndarray] = {}
+        dtypes: dict[str, str] = {}
+        for path, leaf in leaves:
+            arr, orig = _to_savable(np.asarray(leaf))
+            arrays[f"leaf:{path}"] = arr
+            if orig is not None:
+                dtypes[path] = orig
+        meta = {"step": int(step), "epoch": int(epoch),
+                "offset": int(offset), "dtypes": dtypes}
+        meta.update(extra or {})
+        arrays["__meta__"] = np.array(json.dumps(meta))
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, self._path(step))
+        except BaseException:
+            Path(tmp).unlink(missing_ok=True)
+            raise
+        self._prune()
+        return self._path(step)
+
+    def _prune(self) -> None:
+        for s in self.steps()[: -self.keep]:
+            try:
+                self._path(s).unlink()
+            except OSError:
+                pass
+            sidecar = self.directory / f"data_state-{s}.json"
+            sidecar.unlink(missing_ok=True)
+
+    def _load(self, step: int, template):
+        with np.load(self._path(step), allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            leaves, treedef = _flatten_with_paths(template)
+            restored = []
+            for path, leaf in leaves:
+                key = f"leaf:{path}"
+                if key not in z:
+                    raise KeyError(f"checkpoint misses leaf {path!r}")
+                arr = _from_savable(z[key], meta["dtypes"].get(path))
+                want = np.shape(leaf)
+                if tuple(arr.shape) != tuple(want):
+                    raise ValueError(
+                        f"leaf {path!r}: checkpoint shape {arr.shape} != "
+                        f"template shape {want}"
+                    )
+                restored.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, restored), meta
+
+    def restore(self, template, step: int | None = None):
+        """-> (state, meta) from the newest *valid* step; ``None`` when the
+        directory holds nothing restorable (fresh start). Broken files are
+        renamed aside, never deleted — they are evidence."""
+        if step is not None:
+            return self._load(step, template)
+        for s in reversed(self.steps()):
+            try:
+                return self._load(s, template)
+            except Exception as e:
+                bad = self._path(s)
+                try:
+                    os.replace(bad, bad.with_suffix(".npz.corrupt"))
+                    print(
+                        f"host checkpoint step {s} unreadable ({e!r}); "
+                        f"quarantined to {bad.with_suffix('.npz.corrupt')}",
+                        flush=True,
+                    )
+                except OSError:
+                    pass  # concurrent restorer won the rename race
+        return None
+
+
 def restore(
     directory: str | os.PathLike, template: TrainState, step: int | None = None
 ) -> TrainState:
-    """Restore into the template's structure (and shardings, if sharded)."""
+    """Restore into the template's structure (and shardings, if sharded).
+
+    Hardened for the elastic world, where the supervisor routinely kills
+    workers mid-save: when no explicit ``step`` is requested, a step that
+    fails to load (partially written, corrupted) is *quarantined* (moved to
+    ``<directory>.quarantine/``) and the next older step is tried, so the
+    job restores the latest **valid** checkpoint instead of crash-looping
+    on a broken one. An explicit ``step`` keeps strict fail-loud behavior.
+    """
+    if not Path(directory).is_dir():
+        raise FileNotFoundError(f"no checkpoint directory at {directory}")
     _check_layout(Path(directory).absolute())
-    with _manager(directory, create=False) as mgr:
-        if step is None:
-            step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    if step is not None:
+        with _manager(directory, create=False) as mgr:
+            return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    last_err: Exception | None = None
+    tried: set[int] = set()
+    # bounded by the number of steps on disk; each attempt re-opens the
+    # manager so quarantined dirs are really gone from its step listing
+    while True:
+        with _manager(directory, create=False) as mgr:
+            cur = mgr.latest_step()
+            if cur is None:
+                if last_err is not None:
+                    raise FileNotFoundError(
+                        f"no *valid* checkpoints under {directory} "
+                        f"(all steps quarantined; last error: {last_err!r})"
+                    )
+                raise FileNotFoundError(f"no checkpoints under {directory}")
+            if cur in tried:
+                # quarantine could not remove it (permissions?) — fail loud
+                # instead of spinning on the same broken step
+                raise last_err  # type: ignore[misc]
+            try:
+                return mgr.restore(
+                    cur, args=ocp.args.StandardRestore(abstract)
+                )
+            except Exception as e:  # corrupt/partial step: quarantine, retry
+                last_err = e
+                tried.add(cur)
+        quarantine_step(directory, cur)  # a lost race still unblocks retry

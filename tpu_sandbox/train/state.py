@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import numpy as np
 import optax
 from flax import struct
 
@@ -43,3 +44,21 @@ class TrainState:
         if self.batch_stats:
             v["batch_stats"] = self.batch_stats
         return v
+
+    def host_view(self) -> "TrainState":
+        """This process's host-local numpy copy of every leaf.
+
+        For fully-addressable arrays that is the whole value; for
+        multi-controller global arrays it is the first *addressable* shard
+        — the full value for replicated leaves (params, opt state under
+        plain DP), this process's block for sharded ones (its BN-stats
+        replica). This is what elastic workers checkpoint: it needs no
+        collective, so it still works while peer ranks are dead.
+        """
+
+        def to_host(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return np.asarray(leaf.addressable_shards[0].data)
+            return np.asarray(leaf)
+
+        return jax.tree.map(to_host, self)

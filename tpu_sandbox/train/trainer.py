@@ -20,17 +20,30 @@ TPU-first differences:
 
 from __future__ import annotations
 
+import signal
 import time
+from dataclasses import dataclass
 from datetime import timedelta
 from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from tpu_sandbox.ops.losses import cross_entropy_loss
 from tpu_sandbox.train.state import TrainState
+
+#: Exit code the supervisor treats as "preempted: saved, restart for free".
+#: Canonical home is runtime/supervisor.py; mirrored here so the training
+#: layer does not import the process-management layer.
+PREEMPTED_EXIT_CODE = 75
+
+#: KV key a preempted rank raises so every peer stops at the same boundary
+#: (must match supervisor.PREEMPT_KEY; the supervisor clears it between
+#: generations).
+PREEMPT_KEY = "preempt/requested"
 
 
 def resize_on_device(images, image_size):
@@ -283,3 +296,258 @@ class Trainer:
                                 )
                             )
         return state
+
+
+# -- elastic / resumable training -----------------------------------------
+
+class Preempted(RuntimeError):
+    """Raised by ``train_resumable`` after a SIGTERM-initiated checkpoint:
+    state is saved, the process should exit with ``exit_code`` so the
+    supervisor restarts it without charging the restart budget."""
+
+    exit_code = PREEMPTED_EXIT_CODE
+
+    def __init__(self, step: int):
+        super().__init__(
+            f"preempted at optimizer step {step}; checkpoint saved"
+        )
+        self.step = step
+
+
+class AbortOnAnomaly(RuntimeError):
+    """``max_bad_steps`` consecutive non-finite losses: the run is
+    diverging, not glitching — restarting would replay the same batches
+    into the same blowup, so fail for real (charges the restart budget)."""
+
+
+class PreemptionHandler:
+    """SIGTERM → finish the in-flight step, checkpoint, exit preempted.
+
+    The handler itself only flips a flag (a signal handler that touched
+    the KV client could re-enter its request lock mid-call and deadlock);
+    all real work happens at the next step boundary via :meth:`sync`,
+    which also *propagates* the preemption through the KV store — in a
+    multi-controller job the save must happen at the same boundary on
+    every rank, and peers that never received the signal learn about it
+    from the ``preempt/requested`` key.
+    """
+
+    def __init__(self, kv=None, key: str = PREEMPT_KEY):
+        self.kv = kv
+        self.key = key
+        self._flag = False
+        self._announced = False
+        self._prev = None
+
+    def install(self) -> "PreemptionHandler":
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._on_signal)
+        except ValueError:
+            self._prev = None  # not the main thread (tests); KV still works
+        return self
+
+    def uninstall(self) -> None:
+        if self._prev is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev)
+            except ValueError:
+                pass
+            self._prev = None
+
+    def _on_signal(self, signum, frame) -> None:
+        self._flag = True  # flag only — see class docstring
+
+    def preempt_now(self) -> None:
+        """Programmatic preemption (tests)."""
+        self._flag = True
+
+    def requested(self) -> bool:
+        """True once this rank should stop: locally signaled or a peer
+        announced through the store. Call at step boundaries only."""
+        if self._flag:
+            if self.kv is not None and not self._announced:
+                try:
+                    self.kv.set(self.key, b"1")
+                except Exception:
+                    pass  # store gone: still honor the local signal
+                self._announced = True
+            return True
+        if self.kv is not None:
+            try:
+                if self.kv.try_get(self.key) is not None:
+                    self._flag = True
+                    return True
+            except Exception:
+                pass
+        return False
+
+
+def _loss_is_finite(loss) -> bool:
+    """Finite check that works for scalars, per-rank loss vectors, and
+    multi-controller global arrays (where the on-device reduction yields a
+    replicated scalar, so every process reaches the same verdict)."""
+    if isinstance(loss, jax.Array) and not loss.is_fully_addressable:
+        return int((~jnp.isfinite(loss)).sum()) == 0
+    return bool(np.isfinite(np.asarray(loss)).all())
+
+
+def _host_loss(loss) -> float:
+    if hasattr(loss, "is_fully_addressable") and not loss.is_fully_addressable:
+        loss = loss.addressable_shards[0].data
+    return float(np.ravel(np.asarray(loss))[0])
+
+
+@dataclass
+class ResumableReport:
+    resumed_step: int | None  # optimizer step restored from, None = fresh
+    start_epoch: int
+    start_offset: int
+    steps_applied: int  # optimizer updates this call actually performed
+    skipped_nonfinite: int
+    final_step: int
+    losses: list[float]
+
+
+def train_resumable(
+    step_fn: Callable,
+    state: TrainState,
+    loader,
+    epochs: int,
+    *,
+    save_fn: Callable[[TrainState, int, int, int], None] | None = None,
+    restore_fn: Callable[[], tuple[TrainState, dict] | None] | None = None,
+    ckpt_every: int = 0,
+    preemption: PreemptionHandler | None = None,
+    agree_fn: Callable[[bool], bool] | None = None,
+    injector=None,
+    max_bad_steps: int = 3,
+    log_every: int = 100,
+    log_rank: int | None = None,
+    verbose: bool = True,
+    set_epoch: bool = False,
+) -> tuple[TrainState, ResumableReport]:
+    """The crash-safe epoch loop: checkpoint every ``ckpt_every`` optimizer
+    steps *with data-order state*, resume exactly where the stream stood,
+    survive preemption, and refuse to train on garbage.
+
+    - **Exact data order.** Each checkpoint records (epoch, batch offset);
+      resume re-seeds the loader's deterministic per-epoch order and skips
+      exactly the consumed batches — no batch replayed, none skipped. With
+      ``save_fn=None`` the loop still runs (plain training with guards).
+    - **Preemption.** ``preemption.requested()`` is polled every boundary;
+      when set the in-flight step has already finished, so the loop saves
+      and raises :class:`Preempted` — the caller exits with
+      ``PREEMPTED_EXIT_CODE`` and the supervisor restarts for free.
+      In a multi-controller job pass ``agree_fn`` (an OR-reduction across
+      ranks, e.g. a tiny psum): the KV flag alone is racy — a peer can
+      read its boundary a hair before the signaled rank announces, walk
+      into the next step's collective, and block there forever. The
+      collective vote forces every rank to the same verdict at the same
+      boundary, so the whole world saves and exits 75 together.
+    - **Anomaly guard.** A non-finite loss discards that update (the
+      previous state is kept — ``step_fn`` must therefore NOT donate its
+      input state; build engines with ``donate=False`` for elastic runs)
+      and counts against ``max_bad_steps`` consecutive anomalies, after
+      which :class:`AbortOnAnomaly` ends the run as a real failure. The
+      per-step finite check syncs the loss to host, trading a little
+      step-overlap for the guarantee — the resilience tax.
+    - **Fault injection.** ``injector.maybe_fire(opt_step)`` runs after
+      every applied update, so test faults land at exact, reproducible
+      optimizer steps.
+
+    ``restore_fn() -> (state, meta) | None`` and
+    ``save_fn(state, step, epoch, offset)`` keep this loop agnostic of the
+    checkpoint backend (orbax single-process, HostCheckpoint
+    multi-controller) and of engine sharding.
+    """
+    steps_per_epoch = len(loader)
+    resumed_step = None
+    start_epoch, start_offset = 0, 0
+    if restore_fn is not None:
+        res = restore_fn()
+        if res is not None:
+            state, meta = res
+            resumed_step = int(meta.get("step", 0))
+            # sidecar is authoritative; derive from the step count when it
+            # is missing/corrupt (possible after a kill mid-sidecar-write)
+            start_epoch = int(meta.get("epoch", resumed_step // steps_per_epoch))
+            start_offset = int(
+                meta.get("offset", resumed_step % steps_per_epoch)
+            )
+            if start_offset >= steps_per_epoch:
+                start_epoch += 1
+                start_offset = 0
+    opt_step = resumed_step if resumed_step is not None else 0
+    report = ResumableReport(
+        resumed_step=resumed_step, start_epoch=start_epoch,
+        start_offset=start_offset, steps_applied=0, skipped_nonfinite=0,
+        final_step=opt_step, losses=[],
+    )
+    consecutive_bad = 0
+
+    def checkpoint(epoch: int, offset: int) -> None:
+        if save_fn is not None:
+            save_fn(state, opt_step, epoch, offset)
+
+    for epoch in range(start_epoch, epochs):
+        if set_epoch:
+            loader.set_epoch(epoch)
+        for i, (images, labels) in enumerate(loader):
+            if epoch == start_epoch and i < start_offset:
+                continue  # consumed before the checkpoint: replay nothing
+            new_state, loss = step_fn(state, images, labels)
+            if _loss_is_finite(loss):
+                state = new_state
+                opt_step += 1
+                report.steps_applied += 1
+                consecutive_bad = 0
+                applied = True
+            else:
+                report.skipped_nonfinite += 1
+                consecutive_bad += 1
+                applied = False
+                if verbose:
+                    print(
+                        f"non-finite loss at epoch {epoch + 1} batch "
+                        f"{i + 1}; update skipped "
+                        f"({consecutive_bad}/{max_bad_steps} consecutive)"
+                    )
+                if consecutive_bad >= max_bad_steps:
+                    raise AbortOnAnomaly(
+                        f"{consecutive_bad} consecutive non-finite losses "
+                        f"around optimizer step {opt_step}; aborting"
+                    )
+            saved_here = False
+            if applied and ckpt_every and opt_step % ckpt_every == 0:
+                checkpoint(epoch, i + 1)
+                saved_here = True
+            if injector is not None and applied:
+                injector.maybe_fire(opt_step)
+            if preemption is not None or agree_fn is not None:
+                want = preemption is not None and preemption.requested()
+                stop = agree_fn(want) if agree_fn is not None else want
+                if stop:
+                    if preemption is not None:
+                        # a rank outvoted here (peer was signaled, we were
+                        # not) must still exit with the preempted code
+                        preemption.preempt_now()
+                    if not saved_here:
+                        checkpoint(epoch, i + 1)
+                    report.final_step = opt_step
+                    raise Preempted(opt_step)
+            if applied and (i + 1) % log_every == 0:
+                loss_val = _host_loss(loss)
+                report.losses.append(loss_val)
+                if verbose:
+                    prefix = (
+                        f"Rank [{log_rank}], " if log_rank is not None else ""
+                    )
+                    print(
+                        "{}Epoch [{}/{}], Step [{}/{}], Loss: {:.4f}".format(
+                            prefix, epoch + 1, epochs, i + 1,
+                            steps_per_epoch, loss_val,
+                        )
+                    )
+        start_offset = 0  # only the resumed epoch starts mid-stream
+    report.final_step = opt_step
+    return state, report

@@ -12,9 +12,48 @@ which is why entry scripts call this first.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 _MAX_VIRTUAL = 64
+
+
+def _request_cpu_devices(n: int) -> None:
+    """Ask for ``n`` virtual CPU devices, whatever this jax calls the knob.
+
+    Newer jax exposes the ``jax_num_cpu_devices`` config; older releases
+    only honor the XLA_FLAGS env var, which likewise must be set before
+    the CPU backend initializes.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    flag = "--xla_force_host_platform_device_count"
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith(flag + "=")
+    ]
+    flags.append(f"{flag}={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def configure_worker_cpu(n: int = 1) -> None:
+    """Per-rank worker processes: exactly ``n`` (usually 1) CPU device(s),
+    regardless of any XLA_FLAGS the parent process exported (tests run
+    under a force-8-devices flag which workers must NOT inherit — a mesh
+    of ``world_size`` processes x 8 devices each is not the topology).
+    Must run before the first device query."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        # cross-process CPU collectives run over gloo; without this the CPU
+        # backend refuses multiprocess computations outright
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # jax versions that dropped/renamed the knob enable it themselves
+    _request_cpu_devices(n)
 
 
 def ensure_devices(n: int, force_cpu: bool = False) -> list:
@@ -29,7 +68,7 @@ def ensure_devices(n: int, force_cpu: bool = False) -> list:
     try:
         # Pre-size the CPU client before any backend initializes so the
         # fallback exists. Harmless if real devices suffice.
-        jax.config.update("jax_num_cpu_devices", min(max(n, 1), _MAX_VIRTUAL))
+        _request_cpu_devices(min(max(n, 1), _MAX_VIRTUAL))
         if force_cpu:
             # Exclude the accelerator platform entirely: initializing it just
             # to ignore it can hang (and wastes its memory grant).

@@ -1,0 +1,57 @@
+"""Version compatibility shims for the jax API surface this repo targets.
+
+The framework is written against the modern spellings (``jax.shard_map``
+with ``check_vma``, ``pltpu.CompilerParams``); older installed jax
+releases (0.4.x) ship the same functionality under earlier names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pltpu.TPUCompilerParams``). Everything resolves here once so engine code
+stays written in one idiom and the whole suite runs on either release.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    On modern jax this is exactly ``jax.shard_map``; on 0.4.x it maps to
+    ``jax.experimental.shard_map.shard_map``, translating ``check_vma``
+    (the current name for the replication/varying-manual-axes check) to
+    the old ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` across jax versions: inside shard_map/pmap bodies,
+    the size of a mapped axis. Old releases lack the accessor; ``psum`` of
+    the literal 1 is the classic spelling and constant-folds to the same
+    static int."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(pltpu_module):
+    """The pallas-TPU compiler-params class under its current or legacy
+    name (``CompilerParams`` vs ``TPUCompilerParams``); the constructor
+    fields used in this repo (``dimension_semantics``,
+    ``vmem_limit_bytes``) exist under both."""
+    cls = getattr(pltpu_module, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu_module, "TPUCompilerParams")
+    return cls
