@@ -19,6 +19,8 @@ Real multi-host runs initialize via tpu_sandbox.runtime.bootstrap
 
 import argparse
 
+from tpu_sandbox.utils.cli import add_checkpoint_cli
+
 IMAGE_SHAPE = [3000, 3000]
 
 
@@ -212,9 +214,11 @@ def train_multiprocess_worker(args, world_size):
 def train_elastic_worker(args, world_size):
     """One rank of an elastic generation: heartbeat + generation-scoped
     rendezvous, fault injection from the env plan, resumable training with
-    coordination-free checkpointing (rank 0 writes ``HostCheckpoint`` npz
-    files, every rank reads them back), and SIGTERM → save → exit 75 so the
-    supervisor restarts the generation without charging its budget."""
+    coordination-free checkpointing (host: rank 0 writes npz files; sharded:
+    every rank writes its own shard and rank 0 seals a manifest via
+    two-phase commit — required under --zero, whose optimizer shards live on
+    every rank), and SIGTERM → save → exit 75 so the supervisor restarts
+    the generation without charging its budget."""
     import os
     import sys
 
@@ -233,9 +237,9 @@ def train_elastic_worker(args, world_size):
         Preempted,
         PreemptionHandler,
         TrainState,
+        build_elastic_checkpoint,
         train_resumable,
     )
-    from tpu_sandbox.train.checkpoint import HostCheckpoint
 
     rank = args.rank
     kv = KVClient(port=int(args.kv_port))
@@ -303,7 +307,7 @@ def train_elastic_worker(args, world_size):
     # donate=False: the non-finite guard keeps the PREVIOUS state when an
     # update is discarded, which donated (invalidated) buffers cannot do
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
-                      zero=False, donate=False)
+                      zero=args.zero, donate=False)
 
     # per-boundary preemption vote: OR this rank's flag across the world
     # through a real collective, so every rank reaches the same stop
@@ -314,32 +318,24 @@ def train_elastic_worker(args, world_size):
         local = np.asarray([1.0 if flag else 0.0], np.float32)
         return bool(int(_vote_sum(global_batch_from_local(mesh, local))) > 0)
 
+    gen = os.environ.get("TPU_SANDBOX_GENERATION", "1")
     restore_fn = None
     save_fn = None
+    verifier = None
     if args.ckpt_dir:
-        hc = HostCheckpoint(args.ckpt_dir)
-
-        def restore_fn():
-            res = hc.restore(template)
-            if res is None:
-                return None
-            host_state, meta = res
-            return dp.shard_state(host_state), meta
-
-        def save_fn(dstate, step, epoch, offset):
-            # single-writer: no collective, no barrier — still works while
-            # peer ranks are already dead (the reason orbax is not used here)
-            if rank == 0:
-                # host_view of a sharded leaf is this rank's block (BN stats
-                # carry a leading per-replica axis of 1); fold every leaf to
-                # the unsharded template's shape so save and restore agree
-                host = jax.tree.map(
-                    lambda h, t: np.asarray(h).reshape(np.shape(t)),
-                    dstate.host_view(), template,
-                )
-                hc.save(host, step, epoch=epoch, offset=offset)
-
-    gen = os.environ.get("TPU_SANDBOX_GENERATION", "1")
+        save_fn, restore_fn, verifier = build_elastic_checkpoint(
+            args.ckpt_dir, dp=dp, template=template, rank=rank,
+            world_size=world_size,
+            sharded=bool(args.ckpt_sharded or args.zero),
+            kv=kv, injector=injector,
+            verify_interval=args.ckpt_verify_interval,
+            commit_timeout=float(
+                os.environ.get("TPU_SANDBOX_COMMIT_TIMEOUT", 60.0)
+            ),
+            generation=gen, verbose=rank == 0,
+        )
+    if verifier is not None:
+        verifier.start()
     dstate = dp.shard_state(state)
     try:
         dstate, report = train_resumable(
@@ -371,6 +367,8 @@ def train_elastic_worker(args, world_size):
         raise
     finally:
         preemption.uninstall()
+        if verifier is not None:
+            verifier.stop()
     bootstrap.cleanup()
     hb.stop(deregister=True)
 
@@ -396,14 +394,6 @@ def spawn_elastic(args, world_size):
     except (TypeError, ValueError) as e:
         raise SystemExit(f"invalid TPU_SANDBOX_FAULT_PLAN: {e}") from e
 
-    if args.zero:
-        # ZeRO shards optimizer state across processes; the rank-0-writes
-        # HostCheckpoint would silently drop every other rank's shard
-        raise SystemExit(
-            "--zero is not supported with --elastic yet: the elastic "
-            "checkpoint is written by rank 0 alone and would lose the "
-            "other ranks' optimizer-state shards"
-        )
     if not args.ckpt_dir:
         print("note: --elastic without --ckpt-dir restarts from step 0 "
               "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
@@ -424,6 +414,15 @@ def spawn_elastic(args, world_size):
         passthrough += ["--ckpt-dir", args.ckpt_dir]
     if args.ckpt_every:
         passthrough += ["--ckpt-every", str(args.ckpt_every)]
+    if args.zero:
+        # safe under --elastic since PR 3: ZeRO auto-selects the sharded
+        # checkpoint backend, so every rank's optimizer shard is persisted
+        passthrough += ["--zero"]
+    if args.ckpt_sharded:
+        passthrough += ["--ckpt-sharded"]
+    if args.ckpt_verify_interval:
+        passthrough += ["--ckpt-verify-interval",
+                        str(args.ckpt_verify_interval)]
 
     def build(gen, kv_port):
         port = find_free_port()  # fresh coordinator port per generation
@@ -579,12 +578,7 @@ def main():
                              "picks s2dt on TPU when the image "
                              "size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
-    parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
-                        help="with --ckpt-dir: also save every N steps")
-    parser.add_argument("--ckpt-dir", type=str, default=None,
-                        help="orbax checkpoint dir (save at end of training)")
-    parser.add_argument("--resume", action="store_true",
-                        help="restore the latest checkpoint before training")
+    add_checkpoint_cli(parser)
     parser.add_argument("--force-cpu", action="store_true",
                         help="use virtual CPU devices even if an accelerator is present")
     parser.add_argument("--multiprocess", action="store_true",

@@ -34,6 +34,18 @@ jax.config.update("jax_threefry_partitionable", True)
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Safety net: any ``*_integration`` test module is slow by construction
+    (it spawns real worker processes and waits on supervisors/timeouts), so
+    mark the whole module rather than trusting each test to remember the
+    decorator. Tier-1 (`-m 'not slow'`) stays fast unit tests only."""
+    slow = pytest.mark.slow
+    for item in items:
+        mod = item.module.__name__ if item.module else ""
+        if mod.endswith("_integration"):
+            item.add_marker(slow)
+
+
 @pytest.fixture(scope="session")
 def devices():
     assert jax.device_count() == 8, "expected 8 virtual CPU devices"

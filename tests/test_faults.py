@@ -10,6 +10,7 @@ from tpu_sandbox.runtime.faults import (
     Fault,
     FaultInjector,
     FaultPlan,
+    corrupt_latest_shard,
     corrupt_latest_step,
     corrupt_step_dir,
 )
@@ -21,7 +22,11 @@ def test_fault_validation():
         Fault(0, 1, "explode")
     with pytest.raises(ValueError, match="needs target"):
         Fault(0, 1, "corrupt_ckpt")
+    with pytest.raises(ValueError, match="needs target"):
+        Fault(0, 1, "corrupt_shard")
     Fault(0, 1, "corrupt_ckpt", target="/tmp/ck")  # ok with target
+    Fault(0, 1, "corrupt_shard", target="/tmp/ck")
+    Fault(1, 4, "kill_during_commit")  # no target needed
 
 
 def test_plan_json_and_env_round_trip():
@@ -95,6 +100,65 @@ def test_corrupt_latest_step_orbax_layout(tmp_path):
     (tmp_path / "10" / "x.bin").write_bytes(b"good")
     assert corrupt_latest_step(tmp_path) == tmp_path / "10"
     assert (tmp_path / "10" / "x.bin").read_bytes() != b"good"
+
+
+def test_commit_faults_fire_only_in_the_commit_window():
+    """kill_during_commit must never fire at a step boundary (maybe_fire)
+    and step-boundary faults must never fire from the commit hook — the two
+    channels are disjoint by action, and the KV claim still makes the
+    commit-window fault exactly-once across a restart replay."""
+    plan = (FaultPlan()
+            .add(0, 5, "kill_during_commit")
+            .add(0, 5, "hang_heartbeat"))
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        hung = []
+        inj = FaultInjector(plan, 0, kv,
+                            on_hang_heartbeat=lambda: hung.append(1))
+        # step boundary: only the hang fires; the kill stays for the window
+        assert [f.action for f in inj.maybe_fire(5)] == ["hang_heartbeat"]
+        assert hung == [1]
+        # wrong step inside the window: nothing
+        replay = FaultInjector(plan, 0, kv)
+        assert replay.maybe_fire_commit(4) == []
+        # cannot SIGKILL this test process to observe the real fire; claim
+        # it through a second injector instead and verify the first (the
+        # restarted generation replaying step 5) then sees it as spent
+        assert kv.add("fault/0/claimed", 1) == 1
+        assert replay.maybe_fire_commit(5) == []
+        kv.close()
+
+
+def _sealed_step(tmp_path, step, ranks=2):
+    sd = tmp_path / f"step-{step:08d}"
+    sd.mkdir(parents=True)
+    for r in range(ranks):
+        (sd / f"shard-{r:05d}.npz").write_bytes(b"shardbytes%d" % r)
+    (sd / "MANIFEST.json").write_text("{}")
+    return sd
+
+
+def test_corrupt_latest_shard_targets_one_shard_of_newest_sealed(tmp_path):
+    assert corrupt_latest_shard(tmp_path / "missing") is None
+    old = _sealed_step(tmp_path, 3)
+    new = _sealed_step(tmp_path, 9)
+    torn = tmp_path / "step-00000012"  # newer but unsealed: not a target
+    torn.mkdir()
+    (torn / "shard-00000.npz").write_bytes(b"debris")
+    hit = corrupt_latest_shard(tmp_path, rank=1)
+    assert hit == new / "shard-00001.npz"
+    assert hit.read_bytes() != b"shardbytes1"
+    assert (new / "shard-00000.npz").read_bytes() == b"shardbytes0"
+    assert (new / "MANIFEST.json").exists()  # still LOOKS sealed
+    assert (old / "shard-00001.npz").read_bytes() == b"shardbytes1"
+    # missing rank falls back to the first shard present
+    assert corrupt_latest_shard(tmp_path, rank=7) == new / "shard-00000.npz"
+
+
+def test_corrupt_latest_step_sharded_layout(tmp_path):
+    sd = _sealed_step(tmp_path, 4)
+    assert corrupt_latest_step(tmp_path) == sd
+    assert (sd / "shard-00000.npz").read_bytes() != b"shardbytes0"
 
 
 def test_corrupt_latest_step_npz_layout(tmp_path):

@@ -1,0 +1,89 @@
+"""KV client edge cases around the elastic restart path: the server coming
+up LATE (every relaunched worker races rank 0's listen()), the server dying
+mid-conversation (rank 0 crashed while peers still hold connections), and
+the TTL/prefix hygiene ops the sharded-checkpoint commit leans on."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_sandbox.runtime.bootstrap import find_free_port
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+
+def test_connect_retries_until_server_appears():
+    port = int(find_free_port())
+    started = {}
+
+    def late_start():
+        time.sleep(0.4)  # client spins on ECONNREFUSED meanwhile
+        started["server"] = KVServer(port=port)
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        kv = KVClient(port=port, connect_timeout=10.0)
+        kv.set("hello", b"world")
+        assert kv.try_get("hello") == b"world"
+        kv.close()
+    finally:
+        t.join()
+        started["server"].stop()
+
+
+def test_connect_timeout_is_bounded():
+    port = int(find_free_port())  # nothing ever listens here
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="retried for"):
+        KVClient(port=port, connect_timeout=0.5)
+    # bounded: gave up near the deadline, not after hanging minutes
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_server_death_mid_claim_raises_not_hangs():
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    kv.set("ckpt/g1/5/shard_done/1", b"claimed")
+    server.stop()
+    # the next request on the dead connection must fail loud (the caller —
+    # a rank mid-commit — turns this into its own crash and the supervisor
+    # restarts the generation); a silent hang would wedge the commit window
+    with pytest.raises(RuntimeError):
+        for _ in range(3):  # first call can still ride the closing socket
+            kv.set("ckpt/g1/5/shard_done/0", b"claimed")
+            time.sleep(0.05)
+    kv.close()
+
+
+def test_ttl_key_expires_and_plain_set_clears_ttl():
+    with KVServer() as server:
+        kv = KVClient(port=server.port)
+        kv.set_ttl("claim/a", b"x", ttl=0.2)
+        kv.set_ttl("claim/b", b"y", ttl=0.2)
+        assert kv.try_get("claim/a") == b"x"
+        kv.set("claim/b", b"y2")  # plain set = permanent: TTL dropped
+        time.sleep(0.35)
+        assert kv.try_get("claim/a") is None      # reaped
+        assert kv.keys("claim/") == ["claim/b"]   # survivor
+        assert kv.try_get("claim/b") == b"y2"
+        with pytest.raises(ValueError):
+            kv.set_ttl("claim/c", b"z", ttl=0)
+        kv.close()
+
+
+def test_keys_and_delete_prefix():
+    with KVServer() as server:
+        kv = KVClient(port=server.port)
+        for k in ("ckpt/g1/5/shard_done/0", "ckpt/g1/5/shard_done/1",
+                  "ckpt/g2/5/shard_done/0", "fault/0/claimed"):
+            kv.set(k, b"1")
+        assert kv.keys("ckpt/g1/") == [
+            "ckpt/g1/5/shard_done/0", "ckpt/g1/5/shard_done/1",
+        ]
+        assert kv.delete_prefix("ckpt/g1/") == 2
+        assert kv.keys("ckpt/") == ["ckpt/g2/5/shard_done/0"]
+        assert kv.try_get("fault/0/claimed") == b"1"  # untouched namespace
+        with pytest.raises(ValueError):
+            kv.delete_prefix("")  # whole-store wipe must not be a typo away
+        kv.close()

@@ -1,0 +1,275 @@
+"""ShardedCheckpoint unit tests — manifest, checksums, two-phase commit,
+quarantine fall-through, pruning, and the verifier. All single-process and
+fast (tier-1): the multi-rank protocol is exercised by constructing one
+ShardedCheckpoint object per simulated rank against a shared directory,
+which is exactly the on-disk/KV contract the real per-process ranks see.
+
+Ordering rule for the single-threaded simulations: non-zero ranks save
+FIRST (their save returns right after phase 1), rank 0 saves LAST — its
+save blocks awaiting the others' claims before sealing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_sandbox.runtime.faults import corrupt_latest_shard
+from tpu_sandbox.train.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointVerifier,
+    ShardedCheckpoint,
+    _sha256_file,
+    fold_per_replica,
+    verify_step_dir,
+)
+
+WORLD = 2
+
+
+def _tree(seed: int, world: int = WORLD):
+    """(per-rank local trees, spec, global template) for a toy state:
+    one replicated leaf, one ZeRO-style dim-0-sharded leaf, one
+    per-replica BN-style leaf (leading axis 1 per rank)."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((3, 4)).astype(np.float32)       # rep
+    mom = rng.standard_normal((world * 2, 4)).astype(np.float32)  # shard0
+    bn = rng.standard_normal((world, 5)).astype(np.float32)  # per-replica
+    locals_ = [
+        {"w": w, "mom": mom[r * 2:(r + 1) * 2], "bn": bn[r:r + 1]}
+        for r in range(world)
+    ]
+    spec = {"w": "rep", "mom": "shard0", "bn": "shard0"}
+    template = {"w": w, "mom": mom, "bn": bn[0]}  # unsharded, one replica
+    return locals_, spec, template, {"w": w, "mom": mom, "bn": bn}
+
+
+def _ckpts(directory, world: int = WORLD, **kw):
+    kw.setdefault("commit_timeout", 5.0)
+    return [
+        ShardedCheckpoint(directory, rank=r, world_size=world,
+                          verbose=False, **kw)
+        for r in range(world)
+    ]
+
+
+def _save_all(cks, locals_, spec, step, *, epoch=0, offset=0):
+    oks = []
+    for ck, lt in list(zip(cks, locals_))[::-1]:  # rank 0 last: it seals
+        oks.append(ck.save(lt, spec, step, epoch=epoch, offset=offset))
+    return oks[::-1]
+
+
+def test_round_trip_bitwise(tmp_path):
+    locals_, spec, template, full = _tree(0)
+    cks = _ckpts(tmp_path / "ck")
+    oks = _save_all(cks, locals_, spec, 8, epoch=1, offset=3)
+    assert oks == [True, True]
+    for ck in cks:  # every rank restores the same bytes
+        tree, meta = ck.restore(template)
+        np.testing.assert_array_equal(tree["w"], full["w"])
+        np.testing.assert_array_equal(tree["mom"], full["mom"])
+        # per-replica leaf comes back EXPANDED (world, 5) for exact
+        # per-rank placement at unchanged world size
+        np.testing.assert_array_equal(tree["bn"], full["bn"])
+        assert (meta["step"], meta["epoch"], meta["offset"]) == (8, 1, 3)
+        assert meta["world_size"] == WORLD
+
+
+def test_manifest_contents_and_checksums(tmp_path):
+    locals_, spec, template, _ = _tree(1)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 2)
+    sd = cks[0].step_dir(2)
+    manifest = json.loads((sd / MANIFEST_NAME).read_text())
+    assert manifest["format"].startswith("tpu-sandbox-sharded-ckpt")
+    assert manifest["world_size"] == WORLD
+    assert [s["rank"] for s in manifest["shards"]] == [0, 1]
+    for sh in manifest["shards"]:
+        f = sd / sh["file"]
+        assert _sha256_file(f) == sh["sha256"]
+        assert f.stat().st_size == sh["bytes"]
+    assert verify_step_dir(sd) == []
+    # replicated leaves live in rank 0's shard only
+    with np.load(sd / "shard-00001.npz") as z:
+        assert "leaf:w" not in z.files and "leaf:mom" in z.files
+
+
+def test_torn_step_falls_back_and_quarantines(tmp_path):
+    locals_, spec, template, full = _tree(2)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 1)
+    # newer step: shards written, manifest never sealed (kill in the window)
+    for ck, lt in list(zip(cks, locals_))[1:]:
+        ck.save(lt, spec, 5, epoch=0, offset=0)
+    sd5 = cks[0].step_dir(5)
+    (sd5 / "shard-00000.npz").write_bytes(b"half a shard")
+    assert not (sd5 / MANIFEST_NAME).exists()
+    tree, meta = cks[1].restore(template)
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(tree["mom"], full["mom"])
+    assert not sd5.exists()  # quarantined out of the fallback chain
+    q = tmp_path / "ck.quarantine"
+    assert (q / sd5.name).is_dir()
+
+
+def test_corrupt_shard_detected_by_checksum(tmp_path):
+    locals_, spec, template, full = _tree(3)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 1)
+    _save_all(cks, locals_, spec, 2)
+    hit = corrupt_latest_shard(tmp_path / "ck", rank=1)
+    assert hit is not None and hit.name == "shard-00001.npz"
+    sd2 = cks[0].step_dir(2)
+    assert (sd2 / MANIFEST_NAME).exists()  # still LOOKS sealed
+    assert any(p.startswith("corrupt:") for p in verify_step_dir(sd2))
+    tree, meta = cks[0].restore(template)
+    assert meta["step"] == 1  # fell back past the lying step
+    np.testing.assert_array_equal(tree["w"], full["w"])
+    assert not sd2.exists()
+
+
+def test_explicit_step_is_strict(tmp_path):
+    locals_, spec, template, _ = _tree(4)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 1)
+    corrupt_latest_shard(tmp_path / "ck", rank=0)
+    with pytest.raises(ValueError, match="failed verification"):
+        cks[0].restore(template, step=1)
+    # strict mode quarantines nothing — the evidence stays in place
+    assert cks[0].step_dir(1).exists()
+
+
+def test_commit_timeout_leaves_step_unsealed(tmp_path):
+    locals_, spec, _, _ = _tree(5)
+    ck0 = ShardedCheckpoint(tmp_path / "ck", rank=0, world_size=WORLD,
+                            commit_timeout=0.3, verbose=False)
+    ok = ck0.save(locals_[0], spec, 7, epoch=0, offset=0)  # rank 1 never shows
+    assert ok is False
+    assert not (ck0.step_dir(7) / MANIFEST_NAME).exists()
+    assert ck0.latest_sealed_step() is None
+
+
+def test_commit_hook_phases(tmp_path):
+    locals_, spec, _, _ = _tree(6)
+    cks = _ckpts(tmp_path / "ck")
+    seen = {0: [], 1: []}
+    cks[1].save(locals_[1], spec, 3, epoch=0, offset=0,
+                commit_hook=seen[1].append)
+    cks[0].save(locals_[0], spec, 3, epoch=0, offset=0,
+                commit_hook=seen[0].append)
+    assert seen[1] == ["claimed"]          # non-zero ranks never seal
+    assert seen[0] == ["claimed", "sealing"]
+
+
+def test_prune_keeps_sealed_window_quarantines_old_torn(tmp_path):
+    locals_, spec, _, _ = _tree(7)
+    cks = _ckpts(tmp_path / "ck", keep=2)
+    _save_all(cks, locals_, spec, 1)
+    # an old torn step between sealed ones: must survive as evidence
+    torn = cks[0].step_dir(2)
+    torn.mkdir()
+    (torn / "shard-00000.npz").write_bytes(b"debris")
+    _save_all(cks, locals_, spec, 3)
+    _save_all(cks, locals_, spec, 4)  # prune triggers: sealed {1,3,4}, keep 2
+    assert cks[0].sealed_steps() == [3, 4]
+    assert not cks[0].step_dir(1).exists()      # old sealed: deleted
+    assert not torn.exists()                    # old torn: moved, not deleted
+    assert (tmp_path / "ck.quarantine" / torn.name).is_dir()
+
+
+def test_fold_per_replica_and_reshard(tmp_path):
+    world = 4
+    locals_, spec, template, full = _tree(8, world=world)
+    cks = _ckpts(tmp_path / "ck", world=world)
+    _save_all(cks, locals_, spec, 1)
+    tree, meta = cks[0].restore(template)
+    assert tree["bn"].shape == (world, 5)   # expanded per-replica
+    folded = fold_per_replica(tree, template)
+    np.testing.assert_array_equal(folded["bn"], full["bn"][0])
+    assert folded["mom"].shape == template["mom"].shape
+    # the reassembled tree is the full GLOBAL value — a new world size just
+    # re-slices it downstream; nothing in the file format is world-bound
+    np.testing.assert_array_equal(
+        np.concatenate([locals_[r]["mom"] for r in range(world)], 0),
+        tree["mom"],
+    )
+
+
+def test_verifier_scan_quarantines_bitrot(tmp_path):
+    locals_, spec, template, _ = _tree(9)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 1)
+    _save_all(cks, locals_, spec, 2)
+    v = CheckpointVerifier(cks[0], interval=3600)
+    assert v.scan_once() == []              # clean sweep
+    corrupt_latest_shard(tmp_path / "ck", rank=0)
+    assert v.scan_once() == [2]             # rotted step pulled from chain
+    assert v.corrupt_found == [2]
+    assert cks[0].sealed_steps() == [1]
+
+
+def test_kv_backed_claims_and_cleanup(tmp_path):
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+    locals_, spec, template, full = _tree(10)
+    with KVServer() as server:
+        kv = KVClient(port=server.port)
+        cks = _ckpts(tmp_path / "ck", kv=kv, generation=2)
+        cks[1].save(locals_[1], spec, 6, epoch=0, offset=0)
+        assert kv.keys("ckpt/g2/6/shard_done/") == ["ckpt/g2/6/shard_done/1"]
+        cks[0].save(locals_[0], spec, 6, epoch=0, offset=0)
+        # sealed: claim keys for the step are swept, not left to the TTL
+        assert kv.keys("ckpt/g2/") == []
+        tree, meta = cks[0].restore(template)
+        assert meta["step"] == 6
+        np.testing.assert_array_equal(tree["mom"], full["mom"])
+
+
+def test_unknown_spec_kind_rejected(tmp_path):
+    ck = ShardedCheckpoint(tmp_path / "ck", rank=0, world_size=1,
+                           verbose=False)
+    with pytest.raises(ValueError, match="unknown spec kind"):
+        ck.save({"w": np.zeros(2)}, {"w": "diagonal"}, 0, epoch=0, offset=0)
+
+
+def test_host_npz_coexists_with_step_dirs(tmp_path):
+    """HostCheckpoint npz files and sharded step dirs in one directory must
+    not confuse each other's discovery (files vs dirs)."""
+    from tpu_sandbox.train.checkpoint import HostCheckpoint
+
+    locals_, spec, template, _ = _tree(11)
+    hc = HostCheckpoint(tmp_path / "ck")
+    hc.save(template, 4, epoch=0, offset=0)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 9)
+    assert cks[0].sealed_steps() == [9]
+    assert hc.steps() == [4]
+
+
+def test_verify_ckpt_cli(tmp_path, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from tools.verify_ckpt import main
+
+    locals_, spec, _, _ = _tree(12)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 1)
+    _save_all(cks, locals_, spec, 2)
+    assert main([str(tmp_path / "ck")]) == 0
+    out = capsys.readouterr().out
+    assert "2 sealed" in out and "0 corrupt" in out
+
+    # torn step: reported, but only --strict fails on it
+    torn = cks[0].step_dir(3)
+    torn.mkdir()
+    (torn / "shard-00000.npz").write_bytes(b"debris")
+    assert main([str(tmp_path / "ck")]) == 0
+    assert main([str(tmp_path / "ck"), "--strict"]) == 1
+
+    corrupt_latest_shard(tmp_path / "ck", rank=1)
+    assert main([str(tmp_path / "ck")]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+    assert main([str(tmp_path / "missing")]) == 2

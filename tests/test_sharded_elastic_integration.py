@@ -1,0 +1,142 @@
+"""End-to-end sharded-checkpoint recovery over real processes (CPU, world
+size 2, ZeRO-1 optimizer sharding): the two cases the two-phase commit and
+the integrity manifests exist for.
+
+1. ``kill_during_commit``: rank 0 SIGKILLed INSIDE the commit window (shard
+   claimed, manifest not yet renamed) → the step is torn, never sealed →
+   the restarted generation resumes from the previous *sealed* manifest and
+   the final params AND per-rank optimizer shards are bitwise-identical to
+   an uninterrupted run.
+2. ``corrupt_shard``: a sealed step's shard is scribbled (manifest intact,
+   step still LOOKS committed) → restore catches the SHA-256 mismatch,
+   quarantines the step, falls back to the previous sealed one.
+
+Same spawn-2-jax.distributed-processes-per-generation cost as
+test_elastic_integration.py, hence slow / out of tier-1; the protocol
+itself is covered fast in test_sharded_checkpoint.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "mnist_distributed.py"
+
+# 64 synthetic samples / (bs 4 x 2 ranks) = 8 steps per epoch, 16 total.
+# momentum gives ZeRO real per-rank optimizer state to lose.
+COMMON = [
+    "--elastic", "-g", "2", "--epochs", "2", "--batch-size", "4",
+    "--image-size", "28", "--synthetic-n", "64", "--limit-steps", "8",
+    "--dtype", "fp32", "--plan", "plain", "--log-every", "1000",
+    "--ckpt-every", "2", "--zero", "--opt", "momentum", "--ckpt-sharded",
+]
+TOTAL_STEPS = 16
+WORLD = 2
+
+
+def run_elastic(ckpt_dir, fault_plan=None, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_SANDBOX_BACKOFF"] = "0.1"
+    env["TPU_SANDBOX_TERM_TIMEOUT"] = "10"
+    if fault_plan is not None:
+        env["TPU_SANDBOX_FAULT_PLAN"] = json.dumps(fault_plan)
+    cmd = [sys.executable, str(SCRIPT), *COMMON, "--ckpt-dir", str(ckpt_dir)]
+    return subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def final_shards(ckpt_dir):
+    """Every leaf of every rank's shard of the final sealed step — params
+    (rank 0, replicated) AND each rank's own optimizer-state block."""
+    sd = Path(ckpt_dir) / f"step-{TOTAL_STEPS:08d}"
+    assert (sd / "MANIFEST.json").exists(), f"final step not sealed in {sd}"
+    out = {}
+    for r in range(WORLD):
+        with np.load(sd / f"shard-{r:05d}.npz", allow_pickle=False) as z:
+            for k in z.files:
+                if k.startswith("leaf:"):
+                    out[(r, k)] = z[k].copy()
+    return out
+
+
+def assert_bitwise_same(a, b):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+
+
+def test_kill_during_commit_resumes_from_last_sealed_manifest(tmp_path):
+    ref_dir = tmp_path / "ref"
+    r = run_elastic(ref_dir)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 generation(s)" in r.stdout
+
+    # rank 0 dies INSIDE step 4's commit window: its shard is written and
+    # claimed but the manifest rename never happens → step 4 is torn
+    crash_dir = tmp_path / "crash"
+    r = run_elastic(
+        crash_dir,
+        fault_plan=[{"rank": 0, "step": 4, "action": "kill_during_commit"}],
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    assert "gen1:failure" in out and "gen2:ok" in out, out
+    # step 4 never sealed → generation 2 resumes from sealed step 2, and
+    # the torn step-4 debris is quarantined, not restored from
+    assert "resumed from step 2" in out, out
+    q = crash_dir.parent / (crash_dir.name + ".quarantine")
+    assert any(p.name.startswith("step-00000004") for p in q.iterdir()), (
+        list(q.iterdir()) if q.is_dir() else "no quarantine dir"
+    )
+
+    assert_bitwise_same(final_shards(ref_dir), final_shards(crash_dir))
+
+
+def test_corrupt_sealed_shard_detected_and_fallen_past(tmp_path):
+    ref_dir = tmp_path / "ref"
+    r = run_elastic(ref_dir)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # rank 0's maybe_fire(6) runs right AFTER it sealed step 6 (its save
+    # blocks on the full two-phase commit), so the corruption hits a step
+    # the manifest vouches for — then the kill forces a restart that must
+    # see through the lie
+    rot_dir = tmp_path / "rot"
+    r = run_elastic(
+        rot_dir,
+        fault_plan=[
+            {"rank": 0, "step": 6, "action": "corrupt_shard",
+             "target": str(rot_dir)},
+            {"rank": 0, "step": 6, "action": "kill"},
+        ],
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    assert "gen1:failure" in out and "gen2:ok" in out, out
+    # sealed-but-corrupt step 6 fails its SHA-256 check → quarantined →
+    # fall back to sealed step 4
+    assert "resumed from step 4" in out, out
+    q = rot_dir.parent / (rot_dir.name + ".quarantine")
+    assert any(p.name.startswith("step-00000006") for p in q.iterdir()), (
+        list(q.iterdir()) if q.is_dir() else "no quarantine dir"
+    )
+
+    assert_bitwise_same(final_shards(ref_dir), final_shards(rot_dir))
+
+    # the offline auditor agrees the surviving directory is clean
+    sys.path.insert(0, str(REPO))
+    from tools.verify_ckpt import main as verify_main
+
+    assert verify_main([str(rot_dir)]) == 0

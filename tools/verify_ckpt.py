@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Offline integrity audit of a sharded checkpoint directory.
+
+Walks every ``step-XXXXXXXX/`` under the given directory, re-hashes each
+shard against its manifest's SHA-256, and prints one line per step:
+
+    step 00000012  sealed    2 shard(s), 1.3 MiB
+    step 00000016  torn      no manifest (commit never completed)
+    step 00000020  CORRUPT   shard-00001.npz: sha256 mismatch
+
+Exit status: 0 when every sealed step verifies (torn steps are expected
+debris of a kill inside the commit window and do NOT fail the audit —
+restore skips them by design), 1 when any sealed step is corrupt, 2 on
+usage errors. ``--strict`` also fails on torn steps, for post-run checks
+where the job is known to have finished cleanly.
+
+HostCheckpoint npz files (``step-*.npz``) sitting in the same directory
+are checked for basic loadability with ``--host-npz`` (they carry no
+checksums — presence of a readable zip is the best available signal).
+
+Runs from a repo checkout without installation:
+    python tools/verify_ckpt.py /path/to/ckpt-dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import zipfile
+from pathlib import Path
+
+
+def _ensure_import_path() -> None:
+    root = Path(__file__).resolve().parent.parent
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+
+
+def _dir_bytes(step_dir: Path) -> int:
+    return sum(p.stat().st_size for p in step_dir.iterdir() if p.is_file())
+
+
+def main(argv=None) -> int:
+    _ensure_import_path()
+    from tpu_sandbox.train.checkpoint import _parse_step_dir, verify_step_dir
+
+    ap = argparse.ArgumentParser(
+        description="re-hash sharded checkpoint steps against their "
+                    "manifests; exit 1 on corruption"
+    )
+    ap.add_argument("directory", help="checkpoint directory to audit")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on torn (unsealed) steps too, not just "
+                         "corrupt ones")
+    ap.add_argument("--host-npz", action="store_true",
+                    help="also check HostCheckpoint step-*.npz files for "
+                         "loadability (no checksums exist for those)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print problems and the summary line")
+    args = ap.parse_args(argv)
+
+    root = Path(args.directory)
+    if not root.is_dir():
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 2
+
+    step_dirs = sorted(
+        (p for p in root.iterdir() if _parse_step_dir(p) is not None),
+        key=lambda p: _parse_step_dir(p),
+    )
+    sealed = torn = corrupt = 0
+    for sd in step_dirs:
+        step = _parse_step_dir(sd)
+        problems = verify_step_dir(sd)
+        if not problems:
+            sealed += 1
+            if not args.quiet:
+                shards = len(list(sd.glob("shard-*.npz")))
+                mib = _dir_bytes(sd) / (1 << 20)
+                print(f"step {step:08d}  sealed    "
+                      f"{shards} shard(s), {mib:.1f} MiB")
+            continue
+        if all(p.startswith("torn:") for p in problems):
+            torn += 1
+            print(f"step {step:08d}  torn      "
+                  + "; ".join(p.split(": ", 1)[-1] for p in problems))
+        else:
+            corrupt += 1
+            print(f"step {step:08d}  CORRUPT   "
+                  + "; ".join(p.split(": ", 1)[-1] for p in problems))
+
+    npz_bad = 0
+    if args.host_npz:
+        for f in sorted(root.glob("step-*.npz")):
+            tail = f.stem.split("-", 1)[1]
+            if not tail.isdigit():
+                continue
+            ok = zipfile.is_zipfile(f)
+            if not ok:
+                npz_bad += 1
+                print(f"npz  {f.name}  UNREADABLE (not a zip archive)")
+            elif not args.quiet:
+                print(f"npz  {f.name}  readable")
+
+    quarantine = root.parent / (root.name + ".quarantine")
+    quarantined = (
+        len([p for p in quarantine.iterdir() if p.is_dir()])
+        if quarantine.is_dir() else 0
+    )
+
+    print(f"{len(step_dirs)} step(s): {sealed} sealed, {torn} torn, "
+          f"{corrupt} corrupt"
+          + (f"; {npz_bad} unreadable npz" if args.host_npz else "")
+          + (f"; {quarantined} previously quarantined" if quarantined else ""))
+    if corrupt or npz_bad:
+        return 1
+    if args.strict and torn:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,16 @@
 //   response: status u8 | vallen u32 | val
 //   ops     : 'S' set, 'G' get (blocks until key exists), 'T' try-get
 //             (non-blocking; status 2 when the key is missing), 'A' atomic
-//             add (value is decimal i64; returns new value), 'D' delete.
+//             add (value is decimal i64; returns new value), 'D' delete,
+//             'L' list keys with prefix (key = prefix; returns keys joined
+//             by '\n'), 'P' delete every key with prefix (returns count),
+//             'X' set with TTL (value = "<ttl-seconds>\n<payload>"; the key
+//             expires lazily — purged on the next request after its
+//             deadline, and treated as missing by G/T/L once expired).
+//             TTL/prefix ops exist for coordination hygiene: claim keys
+//             (fault claims, checkpoint shard-done claims) must not
+//             accumulate across supervisor generations on a long-lived
+//             server, nor alias a later generation's claims.
 // C ABI at the bottom; Python wrapper in tpu_sandbox/runtime/kvstore.py.
 
 #include <arpa/inet.h>
@@ -23,8 +32,10 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -34,10 +45,13 @@
 
 namespace {
 
+using Clock = std::chrono::steady_clock;
+
 struct Server {
   int listen_fd = -1;
   int port = 0;
   std::map<std::string, std::string> data;
+  std::map<std::string, Clock::time_point> expiry;  // keys set with TTL
   std::mutex mu;
   std::condition_variable cv;
   std::vector<std::thread> conns;
@@ -84,6 +98,26 @@ bool write_response(int fd, uint8_t status, const std::string& val) {
          (val.empty() || write_exact(fd, val.data(), val.size()));
 }
 
+// Lazily drop expired keys. Caller holds srv->mu.
+void purge_expired(Server* srv) {
+  auto now = Clock::now();
+  for (auto it = srv->expiry.begin(); it != srv->expiry.end();) {
+    if (it->second <= now) {
+      srv->data.erase(it->first);
+      it = srv->expiry.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+// Key present and not past its TTL deadline. Caller holds srv->mu.
+bool key_alive(Server* srv, const std::string& key) {
+  if (!srv->data.count(key)) return false;
+  auto it = srv->expiry.find(key);
+  return it == srv->expiry.end() || it->second > Clock::now();
+}
+
 void serve_conn(Server* srv, int fd) {
   for (;;) {
     uint8_t op;
@@ -93,7 +127,27 @@ void serve_conn(Server* srv, int fd) {
     if (op == 'S') {
       {
         std::lock_guard<std::mutex> lk(srv->mu);
+        purge_expired(srv);
         srv->data[key] = val;
+        srv->expiry.erase(key);  // a plain set clears any previous TTL
+      }
+      srv->cv.notify_all();
+      if (!write_response(fd, 0, "")) break;
+    } else if (op == 'X') {
+      // value = "<ttl-seconds>\n<payload>"
+      size_t nl = val.find('\n');
+      if (nl == std::string::npos) {
+        write_response(fd, 1, "bad ttl");
+        break;
+      }
+      double ttl = std::strtod(val.substr(0, nl).c_str(), nullptr);
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        purge_expired(srv);
+        srv->data[key] = val.substr(nl + 1);
+        srv->expiry[key] =
+            Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                               std::chrono::duration<double>(ttl));
       }
       srv->cv.notify_all();
       if (!write_response(fd, 0, "")) break;
@@ -102,7 +156,7 @@ void serve_conn(Server* srv, int fd) {
       {
         std::unique_lock<std::mutex> lk(srv->mu);
         srv->cv.wait(lk, [&] {
-          return srv->stopping || srv->data.count(key) > 0;
+          return srv->stopping || key_alive(srv, key);
         });
         if (srv->stopping) break;
         out = srv->data[key];
@@ -113,22 +167,52 @@ void serve_conn(Server* srv, int fd) {
       bool found;
       {
         std::lock_guard<std::mutex> lk(srv->mu);
-        auto it = srv->data.find(key);
-        found = it != srv->data.end();
-        if (found) out = it->second;
+        purge_expired(srv);
+        found = key_alive(srv, key);
+        if (found) out = srv->data[key];
       }
       if (!write_response(fd, found ? 0 : 2, out)) break;
+    } else if (op == 'L') {
+      // key = prefix; newline-joined matches (keys never contain '\n' in
+      // this framework's usage — they are path-like ASCII identifiers)
+      std::string out;
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        purge_expired(srv);
+        for (auto it = srv->data.lower_bound(key);
+             it != srv->data.end() && it->first.compare(0, key.size(), key) == 0;
+             ++it) {
+          if (!out.empty()) out += '\n';
+          out += it->first;
+        }
+      }
+      if (!write_response(fd, 0, out)) break;
+    } else if (op == 'P') {
+      int64_t count = 0;
+      {
+        std::lock_guard<std::mutex> lk(srv->mu);
+        auto it = srv->data.lower_bound(key);
+        while (it != srv->data.end() &&
+               it->first.compare(0, key.size(), key) == 0) {
+          srv->expiry.erase(it->first);
+          it = srv->data.erase(it);
+          ++count;
+        }
+      }
+      if (!write_response(fd, 0, std::to_string(count))) break;
     } else if (op == 'A') {
       int64_t delta = std::strtoll(val.c_str(), nullptr, 10);
       int64_t now;
       {
         std::lock_guard<std::mutex> lk(srv->mu);
+        purge_expired(srv);
         int64_t cur = 0;
         auto it = srv->data.find(key);
         if (it != srv->data.end())
           cur = std::strtoll(it->second.c_str(), nullptr, 10);
         now = cur + delta;
         srv->data[key] = std::to_string(now);
+        srv->expiry.erase(key);  // counters do not expire
       }
       srv->cv.notify_all();
       if (!write_response(fd, 0, std::to_string(now))) break;
@@ -136,6 +220,7 @@ void serve_conn(Server* srv, int fd) {
       {
         std::lock_guard<std::mutex> lk(srv->mu);
         srv->data.erase(key);
+        srv->expiry.erase(key);
       }
       if (!write_response(fd, 0, "")) break;
     } else {

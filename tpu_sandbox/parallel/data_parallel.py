@@ -134,21 +134,34 @@ class DataParallel:
             opt_state=opt_specs,
         )
 
-    def shard_state(self, state: TrainState) -> TrainState:
+    def shard_state(
+        self, state: TrainState, *, stats_expanded: bool = False
+    ) -> TrainState:
         """Place a single-device state on the mesh: params/opt replicated
         (DDP's param broadcast), BN stats expanded to one copy per rank.
+
+        ``stats_expanded=True``: the batch-stats leaves already carry the
+        leading per-replica axis of size ``self.size`` (a sharded-checkpoint
+        restore at unchanged world size hands back every rank's own replica)
+        and are placed as-is instead of broadcast from one copy — the exact
+        per-replica resume. Under ZeRO the optimizer-state leaves are
+        re-sliced here whatever world size wrote them, because the input is
+        always the full reassembled value: this IS the cross-shard reshard.
 
         Works in multi-controller (multi-process) runs too: every process
         must hold the same host values (same seed -> same init, exactly the
         reference's implicit contract), and each process materializes only
         its addressable shards via ``make_array_from_callback``.
         """
-        expanded = state.replace(
-            batch_stats=jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
-                state.batch_stats,
+        if stats_expanded:
+            expanded = state
+        else:
+            expanded = state.replace(
+                batch_stats=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
+                    state.batch_stats,
+                )
             )
-        )
         specs = self._specs(expanded)
         if jax.process_count() == 1:
             return jax.tree.map(
@@ -172,6 +185,20 @@ class DataParallel:
         """Single-device view: params as-is, rank ``rank``'s BN stats."""
         return state.replace(
             batch_stats=jax.tree.map(lambda x: x[rank], state.batch_stats)
+        )
+
+    def checkpoint_spec(self, state: TrainState) -> TrainState:
+        """Per-leaf placement kinds for the sharded checkpoint layer,
+        derived from the same specs that placed the state: ``"shard0"``
+        for leaves sharded on the data axis (BN-stats replicas; under
+        ZeRO-1 the eligible optimizer-state blocks), ``"rep"`` for
+        everything replicated. ``state`` is the SHARDED state (expanded
+        BN stats) — global shapes feed the same ZeRO eligibility rule
+        that placed the leaves, so save and placement can never disagree."""
+        return jax.tree.map(
+            lambda s: "shard0" if s == P(self.axis) else "rep",
+            self._specs(state),
+            is_leaf=lambda x: isinstance(x, P),
         )
 
     def shard_batch(self, images, labels):

@@ -29,6 +29,16 @@ Actions:
 ``corrupt_ckpt``    scribble garbage over the newest checkpoint step under
                     ``target`` — exercises restore's quarantine-and-fall-
                     back path (checkpoint.py).
+``corrupt_shard``   scribble garbage over this rank's shard file in the
+                    newest SEALED sharded-checkpoint step under ``target``
+                    — the step still *looks* committed (manifest intact),
+                    so only the SHA-256 verification can catch it.
+``kill_during_commit``  SIGKILL self from INSIDE the checkpoint commit
+                    window (after this rank's shard is written and claimed,
+                    on rank 0 right before the manifest rename) — the
+                    torn-step case the two-phase protocol exists for. Fired
+                    via ``maybe_fire_commit`` from the checkpoint layer's
+                    commit hook, never at a step boundary.
 """
 
 from __future__ import annotations
@@ -42,7 +52,12 @@ from typing import Callable, Mapping, MutableMapping
 
 ENV_PLAN = "TPU_SANDBOX_FAULT_PLAN"
 
-ACTIONS = ("kill", "sigterm", "hang_heartbeat", "corrupt_ckpt")
+ACTIONS = ("kill", "sigterm", "hang_heartbeat", "corrupt_ckpt",
+           "corrupt_shard", "kill_during_commit")
+
+#: Actions that fire inside the checkpoint commit window (via
+#: ``maybe_fire_commit``) rather than at an optimizer-step boundary.
+COMMIT_ACTIONS = ("kill_during_commit",)
 
 
 @dataclass(frozen=True)
@@ -50,15 +65,17 @@ class Fault:
     rank: int
     step: int
     action: str
-    target: str | None = None  # corrupt_ckpt: the checkpoint directory
+    target: str | None = None  # corrupt_ckpt/corrupt_shard: checkpoint dir
 
     def __post_init__(self):
         if self.action not in ACTIONS:
             raise ValueError(
                 f"unknown fault action {self.action!r}; choose from {ACTIONS}"
             )
-        if self.action == "corrupt_ckpt" and not self.target:
-            raise ValueError("corrupt_ckpt needs target=<checkpoint dir>")
+        if self.action in ("corrupt_ckpt", "corrupt_shard") and not self.target:
+            raise ValueError(
+                f"{self.action} needs target=<checkpoint dir>"
+            )
 
 
 class FaultPlan:
@@ -135,11 +152,34 @@ class FaultInjector:
         return True
 
     def maybe_fire(self, step: int) -> list[Fault]:
-        """Fire this rank's faults scheduled exactly at ``step``; returns the
-        faults that fired (kill, of course, never returns)."""
+        """Fire this rank's step-boundary faults scheduled exactly at
+        ``step``; returns the faults that fired (kill, of course, never
+        returns). Commit-window faults are skipped here — they belong to
+        :meth:`maybe_fire_commit`."""
         fired = []
         for i, f in enumerate(self.plan.faults):
             if f.rank != self.rank or f.step != step:
+                continue
+            if f.action in COMMIT_ACTIONS:
+                continue
+            if not self._claim(i):
+                continue
+            self._fire(f)
+            fired.append(f)
+        return fired
+
+    def maybe_fire_commit(self, step: int) -> list[Fault]:
+        """Fire this rank's commit-window faults for ``step``. Called by
+        the sharded checkpoint's commit hook, i.e. from INSIDE the
+        two-phase save — after this rank's shard claim, and on rank 0
+        between claim-gathering and the manifest rename. The KV claim
+        still applies: the relaunched generation re-saves the same step
+        without being re-killed."""
+        fired = []
+        for i, f in enumerate(self.plan.faults):
+            if f.rank != self.rank or f.step != step:
+                continue
+            if f.action not in COMMIT_ACTIONS:
                 continue
             if not self._claim(i):
                 continue
@@ -148,7 +188,7 @@ class FaultInjector:
         return fired
 
     def _fire(self, f: Fault) -> None:
-        if f.action == "kill":
+        if f.action in ("kill", "kill_during_commit"):
             os.kill(os.getpid(), signal.SIGKILL)
         elif f.action == "sigterm":
             # handler (trainer.PreemptionHandler) runs at the next bytecode
@@ -159,6 +199,8 @@ class FaultInjector:
                 self.on_hang_heartbeat()
         elif f.action == "corrupt_ckpt":
             corrupt_latest_step(f.target)
+        elif f.action == "corrupt_shard":
+            corrupt_latest_shard(f.target, rank=self.rank)
 
 
 # -- checkpoint corruption (also used directly by tests) -------------------
@@ -175,12 +217,50 @@ def corrupt_step_dir(step_dir: str | os.PathLike) -> list[Path]:
     return touched
 
 
+def _sealed_sharded_steps(root: Path) -> list[Path]:
+    """Sealed ShardedCheckpoint step dirs (``step-XXXXXXXX/`` holding a
+    MANIFEST.json), sorted by step number."""
+    out = []
+    for p in root.glob("step-*"):
+        tail = p.name.split("-", 1)[1]
+        if p.is_dir() and tail.isdigit() and (p / "MANIFEST.json").exists():
+            out.append(p)
+    return sorted(out, key=lambda p: int(p.name.split("-", 1)[1]))
+
+
+def corrupt_latest_shard(
+    directory: str | os.PathLike, rank: int = 0
+) -> Path | None:
+    """Scribble over ONE shard file of the newest *sealed* sharded step —
+    the manifest stays intact, so the step still looks committed and only
+    the restore-time SHA-256 check (or the verifier) can tell. Prefers
+    rank ``rank``'s shard, falls back to the first shard present. Returns
+    the file corrupted, or None when no sealed sharded step exists."""
+    root = Path(directory)
+    if not root.is_dir():
+        return None
+    sealed = _sealed_sharded_steps(root)
+    if not sealed:
+        return None
+    sd = sealed[-1]
+    target = sd / f"shard-{rank:05d}.npz"
+    if not target.exists():
+        shards = sorted(sd.glob("shard-*.npz"))
+        if not shards:
+            return None
+        target = shards[0]
+    target.write_bytes(b"\xde\xad\xbe\xef bitrot " * 4)
+    return target
+
+
 def corrupt_latest_step(directory: str | os.PathLike) -> Path | None:
     """Corrupt the newest committed checkpoint step under ``directory``.
 
-    Understands both on-disk layouts in this repo: orbax step directories
-    (numeric child dirs) and HostCheckpoint step files (``step-*.npz``).
-    Returns what was corrupted, or None when the dir holds no steps yet.
+    Understands all three on-disk layouts in this repo: orbax step
+    directories (numeric child dirs), sealed ShardedCheckpoint step dirs
+    (``step-XXXXXXXX/`` with a manifest), and HostCheckpoint step files
+    (``step-*.npz``). Returns what was corrupted, or None when the dir
+    holds no steps yet.
     """
     root = Path(directory)
     if not root.is_dir():
@@ -190,6 +270,10 @@ def corrupt_latest_step(directory: str | os.PathLike) -> Path | None:
         latest = max(step_dirs, key=lambda p: int(p.name))
         corrupt_step_dir(latest)
         return latest
+    sealed = _sealed_sharded_steps(root)
+    if sealed:
+        corrupt_step_dir(sealed[-1])
+        return sealed[-1]
     npzs = [
         p for p in root.glob("step-*.npz")
         if p.stem.split("-", 1)[1].isdigit()

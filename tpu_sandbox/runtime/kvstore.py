@@ -119,6 +119,17 @@ class KVClient:
             value = value.encode()
         self._request("S", key, value)
 
+    def set_ttl(self, key: str, value: bytes | str, ttl: float) -> None:
+        """Set with a server-side time-to-live: the key reads as missing
+        (and is purged) once ``ttl`` seconds pass. The hygiene primitive
+        for claim keys — a crashed generation's shard-done/fault claims
+        must not satisfy (or pollute) a later generation forever."""
+        if isinstance(value, str):
+            value = value.encode()
+        if ttl <= 0:
+            raise ValueError(f"ttl must be > 0, got {ttl}")
+        self._request("X", key, f"{ttl}\n".encode() + value)
+
     def get(self, key: str) -> bytes:
         """Blocks until the key exists (TCPStore wait-get semantics)."""
         return self._request("G", key)
@@ -141,6 +152,20 @@ class KVClient:
 
     def delete(self, key: str) -> None:
         self._request("D", key)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        """All live keys starting with ``prefix`` (sorted; expired TTL keys
+        excluded). Empty prefix lists the whole store."""
+        raw = self._request("L", prefix)
+        return [k.decode() for k in raw.split(b"\n") if k] if raw else []
+
+    def delete_prefix(self, prefix: str) -> int:
+        """Delete every key starting with ``prefix``; returns how many went.
+        Refuses the empty prefix — 'wipe the whole store' should never be
+        one typo away from 'clean my namespace'."""
+        if not prefix:
+            raise ValueError("delete_prefix needs a non-empty prefix")
+        return int(self._request("P", prefix))
 
     def barrier(self, world_size: int, key: str = "barrier") -> None:
         """All ``world_size`` callers block until everyone arrived."""

@@ -156,11 +156,27 @@ class Supervisor:
         immediately-dead rank, and unequal rendezvous counters (a rank that
         died before joining) would wedge ``wait_for_world`` forever. Fault
         claims are deliberately NOT cleared — a fault fires once per job,
-        not once per generation."""
+        not once per generation. Checkpoint shard-done claims ARE cleared:
+        they are per-commit-attempt state, and a relaunched generation
+        re-reaching the same step must gather fresh claims, never its dead
+        predecessor's (the claims are generation-scoped and TTL'd as well —
+        this sweep is the belt to those braces, and keeps a long-lived
+        server from accumulating dead keys across generations)."""
         for r in range(self.world_size):
             kv.delete(_hb_key(r))
             kv.delete(f"rendezvous/gen/{r}")
         kv.delete(PREEMPT_KEY)
+        kv.delete_prefix("ckpt/")
+
+    def _reset_job_plane(self, kv: KVClient) -> None:
+        """Job-start sweep for an EXTERNAL long-lived KV server reused
+        across supervisor runs: the previous job's fault claims would make
+        this job's identical fault plan never fire, and its stale commit
+        claims could alias this job's. Runs once, before generation 1 —
+        within a job, fault claims persist across generations (fire-once
+        semantics)."""
+        kv.delete_prefix("fault/")
+        kv.delete_prefix("ckpt/")
 
     # -- teardown ----------------------------------------------------------
 
@@ -267,6 +283,7 @@ class Supervisor:
         result = ElasticResult(self.world_size)
         server = self._kv_server or KVServer()
         kv = KVClient(port=server.port)
+        self._reset_job_plane(kv)
         prev_handler = self._install_forwarder()
         gen = 0
         try:

@@ -6,6 +6,7 @@ from tpu_sandbox.train.trainer import (  # noqa: F401
     PreemptionHandler,
     ResumableReport,
     Trainer,
+    build_elastic_checkpoint,
     make_train_step,
     prepare_inputs,
     resize_on_device,

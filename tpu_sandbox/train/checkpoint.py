@@ -12,9 +12,13 @@ leaves are sharded) target shardings.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 import tempfile
+import threading
+import time
 from pathlib import Path
 
 import jax
@@ -364,6 +368,514 @@ class HostCheckpoint:
                 except OSError:
                     pass  # concurrent restorer won the rename race
         return None
+
+
+# -- ShardedCheckpoint: per-rank shards + manifest + two-phase commit ------
+#
+# HostCheckpoint's rank-0-only npz silently drops every non-replicated leaf
+# that is not rank 0's (under ZeRO-1 that is (N-1)/N of the optimizer
+# state), has no content integrity beyond "the zipfile parses", and its
+# notion of "latest" is whatever file sorts last. This layer fixes all
+# three:
+#
+#   <dir>/step-00000008/shard-00000.npz   rank 0's leaves
+#   <dir>/step-00000008/shard-00001.npz   rank 1's leaves
+#   <dir>/step-00000008/MANIFEST.json     the seal (written LAST, atomically)
+#   <dir>.quarantine/step-.../            torn/corrupt steps, moved aside
+#
+# Commit is two-phase over the KV store and timeout-bounded (never a
+# barrier — the orbax deadlock in ROADMAP is exactly a collective commit
+# wedging on a dead rank):
+#   phase 1: every rank writes its shard (tmp+rename), hashes it, and
+#            claims ``ckpt/g<gen>/<step>/shard_done/<rank>`` with the hash.
+#   phase 2: rank 0 publishes MANIFEST.json (shard list + SHA-256s + data-
+#            order meta) only once all claims landed — or gives up at the
+#            deadline, leaving the step unsealed.
+# A kill at ANY instant therefore leaves either a sealed step (manifest
+# present, every referenced shard complete) or a torn one (no manifest),
+# and torn steps are quarantined, never restored from and never pruned
+# into. Claims are generation-scoped and TTL'd so a restarted generation
+# cannot match its predecessor's claims.
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_FORMAT = "tpu-sandbox-sharded-ckpt-v1"
+
+#: Leaf placement kinds recorded in the manifest's spec:
+#:   "rep"    — replicated; stored once, in rank 0's shard.
+#:   "shard0" — sharded on dim 0; each rank stores its block, restore
+#:              concatenates blocks in rank order.
+SPEC_KINDS = ("rep", "shard0")
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _step_dir_name(step: int) -> str:
+    return f"step-{int(step):08d}"
+
+
+def _parse_step_dir(p: Path) -> int | None:
+    if not p.is_dir() or not p.name.startswith("step-"):
+        return None
+    tail = p.name.split("-", 1)[1]
+    return int(tail) if tail.isdigit() else None
+
+
+def verify_step_dir(step_dir: str | os.PathLike) -> list[str]:
+    """Integrity report for one sharded step directory: ``[]`` means sealed
+    and every shard re-hashes to its manifest entry. Problem strings are
+    prefixed ``torn:`` (commit never completed — expected after a kill in
+    the commit window) or ``corrupt:`` (sealed but the bytes changed —
+    bitrot, scribbles, truncation). Module-level so tools/verify_ckpt.py
+    and the in-process verifier share one notion of 'valid'."""
+    sd = Path(step_dir)
+    mf = sd / MANIFEST_NAME
+    if not mf.exists():
+        return ["torn: no manifest (commit never completed)"]
+    try:
+        manifest = json.loads(mf.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"corrupt: manifest unreadable ({e})"]
+    if manifest.get("format") != MANIFEST_FORMAT:
+        return [f"corrupt: unknown manifest format {manifest.get('format')!r}"]
+    problems = []
+    for sh in manifest.get("shards", []):
+        f = sd / sh["file"]
+        if not f.exists():
+            problems.append(f"corrupt: shard {sh['rank']} missing ({sh['file']})")
+            continue
+        size = f.stat().st_size
+        if size != sh["bytes"]:
+            problems.append(
+                f"corrupt: shard {sh['rank']} is {size} bytes, "
+                f"manifest says {sh['bytes']}"
+            )
+            continue
+        digest = _sha256_file(f)
+        if digest != sh["sha256"]:
+            problems.append(
+                f"corrupt: shard {sh['rank']} sha256 {digest[:12]}... != "
+                f"manifest {sh['sha256'][:12]}..."
+            )
+    return problems
+
+
+class ShardedCheckpoint:
+    """Every rank persists its own leaves; rank 0 seals the step.
+
+    ``local_tree`` passed to :meth:`save` is this rank's host view of the
+    state (``TrainState.host_view``: full value for replicated leaves, this
+    rank's block for sharded ones); ``spec_tree`` mirrors it with "rep" /
+    "shard0" kinds (``DataParallel.checkpoint_spec``). Restore reassembles
+    (concatenating shard0 blocks in rank order — which also *reshards*
+    across a world-size change, because the reassembled array is the full
+    global value and placement happens downstream), verifying every shard's
+    SHA-256 against the manifest before a single byte is parsed.
+
+    ``kv=None`` degrades phase 1 to filesystem polling (rank 0 waits for
+    all shard files and hashes them itself) — same commit guarantee on a
+    shared local filesystem, used by single-process tests.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        rank: int,
+        world_size: int,
+        kv=None,
+        keep: int = 3,
+        commit_timeout: float = 60.0,
+        poll: float = 0.02,
+        generation: int | str | None = None,
+        verbose: bool = True,
+    ):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside world of {world_size}")
+        self.directory = Path(directory).absolute()
+        self.rank = rank
+        self.world_size = world_size
+        self.kv = kv
+        self.keep = keep
+        self.commit_timeout = commit_timeout
+        self.poll = poll
+        self.generation = str(generation) if generation is not None else "0"
+        self.verbose = verbose
+
+    # -- paths / keys ------------------------------------------------------
+
+    def step_dir(self, step: int) -> Path:
+        return self.directory / _step_dir_name(step)
+
+    def _shard_name(self, rank: int) -> str:
+        return f"shard-{rank:05d}.npz"
+
+    def _claim_key(self, step: int, rank: int) -> str:
+        # generation-scoped: a relaunched generation re-reaching this step
+        # must gather FRESH claims, not its dead predecessor's
+        return f"ckpt/g{self.generation}/{int(step)}/shard_done/{rank}"
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[sharded-ckpt r{self.rank}] {msg}", flush=True)
+
+    # -- step discovery ----------------------------------------------------
+
+    def steps_on_disk(self) -> list[int]:
+        if not self.directory.is_dir():
+            return []
+        out = []
+        for p in self.directory.iterdir():
+            s = _parse_step_dir(p)
+            if s is not None:
+                out.append(s)
+        return sorted(out)
+
+    def sealed_steps(self) -> list[int]:
+        return [
+            s for s in self.steps_on_disk()
+            if (self.step_dir(s) / MANIFEST_NAME).exists()
+        ]
+
+    def latest_sealed_step(self) -> int | None:
+        s = self.sealed_steps()
+        return s[-1] if s else None
+
+    # -- save (two-phase commit) -------------------------------------------
+
+    def save(
+        self,
+        local_tree,
+        spec_tree,
+        step: int,
+        *,
+        epoch: int,
+        offset: int,
+        extra: dict | None = None,
+        commit_hook=None,
+    ) -> bool:
+        """Phase 1 on every rank, phase 2 (the seal) on rank 0 only.
+
+        Returns True when this rank's part of the commit completed (for
+        rank 0: the manifest is sealed; for others: shard written and
+        claimed — they cannot observe the seal and do not wait for it, or
+        a dead rank 0 would wedge them). ``commit_hook(phase)`` is the
+        fault-injection window: called with "claimed" after this rank's
+        phase-1 claim, and on rank 0 with "sealing" after all claims landed
+        but before the manifest rename — the worst possible kill instants.
+        """
+        sd = self.step_dir(step)
+        sd.mkdir(parents=True, exist_ok=True)
+        leaves, _ = _flatten_with_paths(local_tree)
+        kinds = dict(_flatten_with_paths(spec_tree)[0])
+        arrays: dict[str, np.ndarray] = {}
+        dtypes: dict[str, str] = {}
+        for path, leaf in leaves:
+            kind = kinds[path]
+            if kind not in SPEC_KINDS:
+                raise ValueError(f"leaf {path!r}: unknown spec kind {kind!r}")
+            if kind == "rep" and self.rank != 0:
+                continue  # replicated leaves are stored once, by rank 0
+            arr, orig = _to_savable(np.asarray(leaf))
+            arrays[f"leaf:{path}"] = arr
+            if orig is not None:
+                dtypes[path] = orig
+        arrays["__meta__"] = np.array(json.dumps(
+            {"rank": self.rank, "step": int(step), "dtypes": dtypes}
+        ))
+        final = sd / self._shard_name(self.rank)
+        fd, tmp = tempfile.mkstemp(dir=sd, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, final)
+        except BaseException:
+            Path(tmp).unlink(missing_ok=True)
+            raise
+        claim = {
+            "rank": self.rank,
+            "file": final.name,
+            "sha256": _sha256_file(final),
+            "bytes": final.stat().st_size,
+        }
+        if self.kv is not None:
+            # TTL'd: a claim that outlives its commit window by far is
+            # stale state on a long-lived server, never a commit input
+            self.kv.set_ttl(
+                self._claim_key(step, self.rank), json.dumps(claim),
+                ttl=max(4 * self.commit_timeout, 60.0),
+            )
+        if self.rank != 0:
+            if commit_hook is not None:
+                commit_hook("claimed")
+            return True
+        if commit_hook is not None:
+            commit_hook("claimed")
+        shards = self._await_claims(step, own_claim=claim)
+        if shards is None:
+            self._log(
+                f"step {step}: commit deadline ({self.commit_timeout}s) "
+                "passed with shard claims missing; leaving the step "
+                "UNSEALED (previous sealed step remains the restore point)"
+            )
+            return False
+        if commit_hook is not None:
+            commit_hook("sealing")
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": int(step),
+            "epoch": int(epoch),
+            "offset": int(offset),
+            "world_size": self.world_size,
+            "shards": shards,
+            "spec": {p: k for p, k in kinds.items()},
+        }
+        manifest.update(extra or {})
+        mfd, mtmp = tempfile.mkstemp(dir=sd, suffix=".json.tmp")
+        try:
+            with os.fdopen(mfd, "w") as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(mtmp, sd / MANIFEST_NAME)  # THE seal
+        except BaseException:
+            Path(mtmp).unlink(missing_ok=True)
+            raise
+        if self.kv is not None:
+            try:
+                self.kv.delete_prefix(f"ckpt/g{self.generation}/{int(step)}/")
+            except Exception:
+                pass  # TTL reaps them anyway
+        self._prune()
+        return True
+
+    def _await_claims(self, step: int, own_claim: dict) -> list[dict] | None:
+        """Rank 0's phase-2 wait: all ranks' claims, or None at the
+        deadline. KV-less mode polls the filesystem and hashes the shard
+        files itself once they are all renamed into place."""
+        deadline = time.monotonic() + self.commit_timeout
+        claims: dict[int, dict] = {0: own_claim}
+        while True:
+            for r in range(1, self.world_size):
+                if r in claims:
+                    continue
+                if self.kv is not None:
+                    raw = self.kv.try_get(self._claim_key(step, r))
+                    if raw is not None:
+                        claims[r] = json.loads(raw)
+                else:
+                    f = self.step_dir(step) / self._shard_name(r)
+                    if f.exists() and not f.suffix == ".tmp":
+                        claims[r] = {
+                            "rank": r,
+                            "file": f.name,
+                            "sha256": _sha256_file(f),
+                            "bytes": f.stat().st_size,
+                        }
+            if len(claims) == self.world_size:
+                return [claims[r] for r in range(self.world_size)]
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(self.poll)
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, template, step: int | None = None):
+        """-> (tree, meta) from the newest step that is sealed AND passes
+        checksum verification; ``None`` when nothing restorable exists.
+        Torn and corrupt steps are quarantined (moved aside as evidence)
+        and the next older step is tried — the same fall-through contract
+        as the corrupt-npz path, now checksum-backed. An explicit ``step``
+        is strict: fail loud, quarantine nothing."""
+        if step is not None:
+            problems = verify_step_dir(self.step_dir(step))
+            if problems:
+                raise ValueError(
+                    f"step {step} failed verification: {problems}"
+                )
+            return self._load(step, template)
+        for s in reversed(self.steps_on_disk()):
+            problems = verify_step_dir(self.step_dir(s))
+            if problems:
+                self._quarantine(s, "; ".join(problems))
+                continue
+            try:
+                return self._load(s, template)
+            except Exception as e:  # shapes/leaves wrong despite good hashes
+                self._quarantine(s, repr(e))
+        return None
+
+    def _load(self, step: int, template):
+        sd = self.step_dir(step)
+        manifest = json.loads((sd / MANIFEST_NAME).read_text())
+        spec: dict = manifest["spec"]
+        shard_data: list[dict] = []
+        shard_dtypes: list[dict] = []
+        for sh in sorted(manifest["shards"], key=lambda s: s["rank"]):
+            with np.load(sd / sh["file"], allow_pickle=False) as z:
+                meta = json.loads(str(z["__meta__"]))
+                shard_data.append(
+                    {k[len("leaf:"):]: z[k].copy() for k in z.files
+                     if k.startswith("leaf:")}
+                )
+                shard_dtypes.append(meta.get("dtypes", {}))
+        leaves, treedef = _flatten_with_paths(template)
+        restored = []
+        for path, tleaf in leaves:
+            kind = spec.get(path)
+            if kind is None:
+                raise KeyError(f"manifest misses leaf {path!r}")
+            want = tuple(np.shape(tleaf))
+            if kind == "rep":
+                if path not in shard_data[0]:
+                    raise KeyError(f"rank-0 shard misses leaf {path!r}")
+                arr = _from_savable(
+                    shard_data[0][path], shard_dtypes[0].get(path)
+                )
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"leaf {path!r}: checkpoint shape {arr.shape} != "
+                        f"template shape {want}"
+                    )
+            else:  # shard0: concatenate rank blocks -> the full global value
+                blocks = []
+                for r, data in enumerate(shard_data):
+                    if path not in data:
+                        raise KeyError(f"rank-{r} shard misses leaf {path!r}")
+                    blocks.append(
+                        _from_savable(data[path], shard_dtypes[r].get(path))
+                    )
+                arr = np.concatenate(blocks, axis=0)
+                # exact match: a ZeRO'd optimizer leaf (global shape is
+                # world-independent). (W, *want): a per-replica leaf (BN
+                # stats) — kept expanded; fold_per_replica picks a replica
+                # when the caller cannot place all of them.
+                if tuple(arr.shape) != want and tuple(arr.shape[1:]) != want:
+                    raise ValueError(
+                        f"leaf {path!r}: reassembled shape {arr.shape} "
+                        f"matches neither template {want} nor (world, *{want})"
+                    )
+            restored.append(arr)
+        meta = {k: manifest[k] for k in ("step", "epoch", "offset",
+                                         "world_size")}
+        for k, v in manifest.items():
+            if k not in ("format", "shards", "spec", *meta):
+                meta[k] = v
+        return jax.tree_util.tree_unflatten(treedef, restored), meta
+
+    # -- quarantine / prune ------------------------------------------------
+
+    def _quarantine(self, step: int, reason: str) -> Path | None:
+        """Move a broken step directory to ``<dir>.quarantine/``. Every
+        rank restores concurrently; first rename wins, losers see ENOENT
+        and move on (same benign race as ``quarantine_step``)."""
+        src = self.step_dir(step)
+        qdir = self.directory.with_name(self.directory.name + ".quarantine")
+        qdir.mkdir(parents=True, exist_ok=True)
+        dst = qdir / src.name
+        n = 0
+        while dst.exists():
+            n += 1
+            dst = qdir / f"{src.name}.{n}"
+        try:
+            os.replace(src, dst)
+        except OSError:
+            return None
+        self._log(f"step {step} quarantined to {dst}: {reason}")
+        return dst
+
+    def _prune(self) -> None:
+        """Keep the newest ``keep`` SEALED steps. Older sealed steps are
+        deleted; older torn steps are quarantined, never deleted — a torn
+        step is evidence of a crash, and pruning must never be the thing
+        that destroys the last copy of anything."""
+        sealed = self.sealed_steps()
+        if len(sealed) <= self.keep:
+            cutoff = sealed[0] if sealed else None
+        else:
+            cutoff = sealed[-self.keep]
+        if cutoff is None:
+            return
+        for s in self.steps_on_disk():
+            if s >= cutoff:
+                continue
+            if s in sealed:
+                shutil.rmtree(self.step_dir(s), ignore_errors=True)
+            else:
+                self._quarantine(s, "torn step older than the keep window")
+
+
+def fold_per_replica(tree, template, index: int = 0):
+    """Collapse restored per-replica leaves (shape ``(world, *t.shape)``)
+    to one replica so the tree matches the unsharded template — the
+    fallback when the world size changed across the restart and the saved
+    replicas cannot be placed one-per-rank."""
+    return jax.tree.map(
+        lambda x, t: x[index] if np.shape(x) != np.shape(t) else x,
+        tree, template,
+    )
+
+
+class CheckpointVerifier:
+    """Background bitrot hunter: periodically re-hashes every *sealed* step
+    against its manifest and quarantines any that no longer verify, so a
+    silently-rotted step is pulled out of the fallback chain before it is
+    the only step left (ROADMAP: "caught before the last good step is
+    pruned"). Runs on rank 0 only — verification is read-mostly, and one
+    quarantiner avoids rename storms."""
+
+    def __init__(self, ckpt: ShardedCheckpoint, interval: float = 60.0):
+        self.ckpt = ckpt
+        self.interval = interval
+        self.scans = 0
+        self.corrupt_found: list[int] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def scan_once(self) -> list[int]:
+        """One synchronous sweep; returns the steps quarantined. Public so
+        tests (and the CLI) get determinism without thread timing."""
+        bad = []
+        for s in self.ckpt.sealed_steps():
+            problems = verify_step_dir(self.ckpt.step_dir(s))
+            if problems:
+                self.ckpt._quarantine(
+                    s, "verifier: " + "; ".join(problems)
+                )
+                bad.append(s)
+        self.scans += 1
+        self.corrupt_found.extend(bad)
+        return bad
+
+    def start(self) -> "CheckpointVerifier":
+        if self._thread is not None:
+            return self
+
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.scan_once()
+                except Exception:
+                    pass  # a transient FS error must not kill the thread
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
 
 
 def restore(

@@ -408,6 +408,112 @@ class ResumableReport:
     losses: list[float]
 
 
+def build_elastic_checkpoint(
+    directory,
+    *,
+    dp,
+    template,
+    rank: int,
+    world_size: int,
+    sharded: bool | None = None,
+    kv=None,
+    injector=None,
+    verify_interval: float = 0.0,
+    commit_timeout: float = 60.0,
+    generation: int | str | None = None,
+    keep: int = 3,
+    verbose: bool = True,
+):
+    """Build the (save_fn, restore_fn, verifier) triple ``train_resumable``
+    consumes, picking the checkpoint backend for an elastic run.
+
+    ``sharded=None`` auto-selects: ZeRO mode (``dp.zero``) *requires* the
+    sharded backend — the rank-0-only ``HostCheckpoint`` would silently
+    drop every other rank's optimizer shard — and plain DP defaults to it
+    too unless explicitly disabled. ``sharded=False`` keeps the PR-1 npz
+    path (single rank-0 writer, no manifests).
+
+    - save: each rank hands its host-local view + placement spec to
+      :class:`ShardedCheckpoint`; rank 0 seals with the manifest after the
+      two-phase commit. ``injector.maybe_fire_commit`` is wired into the
+      commit window so ``kill_during_commit`` faults land at the exact
+      nastiest instants.
+    - restore: reassemble + checksum-verify; at unchanged world size every
+      rank gets its own BN-stats replica back bitwise, at a changed world
+      size per-replica leaves fold to replica 0 and ZeRO optimizer shards
+      are re-sliced for the new world (the cross-shard reshard).
+    - verifier: a rank-0 :class:`CheckpointVerifier` when
+      ``verify_interval`` > 0 (caller starts/stops it around training).
+    """
+    from tpu_sandbox.train.checkpoint import (
+        CheckpointVerifier,
+        HostCheckpoint,
+        ShardedCheckpoint,
+        fold_per_replica,
+    )
+
+    if sharded is None:
+        sharded = True
+    if dp.zero and not sharded:
+        raise ValueError(
+            "ZeRO optimizer-state sharding needs the sharded checkpoint "
+            "backend: HostCheckpoint is rank-0-only and would lose every "
+            "other rank's optimizer shard"
+        )
+
+    if not sharded:
+        hc = HostCheckpoint(directory, keep=keep)
+
+        def restore_fn():
+            res = hc.restore(template)
+            if res is None:
+                return None
+            host_state, meta = res
+            return dp.shard_state(host_state), meta
+
+        def save_fn(dstate, step, epoch, offset):
+            if rank == 0:
+                host = jax.tree.map(
+                    lambda h, t: np.asarray(h).reshape(np.shape(t)),
+                    dstate.host_view(), template,
+                )
+                hc.save(host, step, epoch=epoch, offset=offset)
+
+        return save_fn, restore_fn, None
+
+    sc = ShardedCheckpoint(
+        directory, rank=rank, world_size=world_size, kv=kv, keep=keep,
+        commit_timeout=commit_timeout, generation=generation,
+        verbose=verbose,
+    )
+
+    def save_fn(dstate, step, epoch, offset):
+        hook = None
+        if injector is not None:
+            def hook(phase, _step=step):
+                injector.maybe_fire_commit(_step)
+        sc.save(
+            dstate.host_view(), dp.checkpoint_spec(dstate), step,
+            epoch=epoch, offset=offset, commit_hook=hook,
+        )
+
+    def restore_fn():
+        res = sc.restore(template)
+        if res is None:
+            return None
+        host_state, meta = res
+        if int(meta.get("world_size", world_size)) == world_size:
+            # same world: place every rank's own BN replica back bitwise
+            return dp.shard_state(host_state, stats_expanded=True), meta
+        folded = fold_per_replica(host_state, template)
+        return dp.shard_state(folded), meta
+
+    verifier = None
+    if verify_interval > 0 and rank == 0:
+        verifier = CheckpointVerifier(sc, interval=verify_interval)
+    return save_fn, restore_fn, verifier
+
+
 def train_resumable(
     step_fn: Callable,
     state: TrainState,

@@ -19,6 +19,31 @@ import jax
 _MAX_VIRTUAL = 64
 
 
+def add_checkpoint_cli(parser) -> None:
+    """Register the checkpoint flag group shared by the entry scripts.
+
+    One definition site keeps the launcher and its respawned workers
+    agreeing on spelling — spawn/elastic passthrough re-parses these exact
+    flags in the child process.
+    """
+    parser.add_argument("--ckpt-every", type=int, default=0, metavar="N",
+                        help="with --ckpt-dir: also save every N steps")
+    parser.add_argument("--ckpt-dir", type=str, default=None,
+                        help="checkpoint directory (orbax/npz/sharded)")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the newest step from --ckpt-dir first")
+    parser.add_argument("--ckpt-sharded", action="store_true",
+                        help="with --elastic: every rank writes its own "
+                             "shard + SHA-256, rank 0 seals the step with a "
+                             "manifest (two-phase commit). Implied by --zero, "
+                             "whose optimizer shards rank 0 alone cannot see")
+    parser.add_argument("--ckpt-verify-interval", type=float, default=0.0,
+                        metavar="SEC",
+                        help="with sharded checkpoints: rank 0 re-hashes "
+                             "older sealed steps every SEC seconds in the "
+                             "background (0 = off)")
+
+
 def _request_cpu_devices(n: int) -> None:
     """Ask for ``n`` virtual CPU devices, whatever this jax calls the knob.
 
