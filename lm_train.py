@@ -29,6 +29,8 @@ Examples::
 
 import argparse
 
+from tpu_sandbox.utils.cli import add_grad_compress_cli
+
 
 def make_batches(vocab: int, batch: int, seq_len: int, steps: int, seed: int):
     """Deterministic synthetic LM stream: targets = (tokens + k) % vocab with
@@ -128,11 +130,19 @@ def train(args):
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
 
     p = args.parallelism
+    if args.grad_compress != "none" and p != "dp":
+        # the compressed sync intercepts grads as they cross the batch
+        # axis; under tp/sp/pp/ep XLA owns the collective placement
+        raise SystemExit(
+            f"--grad-compress only composes with --parallelism dp "
+            f"(got {p!r}): other plans let XLA place the grad collectives"
+        )
     if p == "dp":
         mesh = make_mesh({"data": n}, devices=devices)
         model = TransformerLM(cfg, attention_fn=attention_fn)
         state = TrainState.create(model, rng, sample, tx)
-        eng = PjitEngine(model, tx, mesh, task="lm")
+        eng = PjitEngine(model, tx, mesh, task="lm",
+                         grad_compress=args.grad_compress)
     elif p == "tp":
         if args.dp < 1 or n % args.dp:
             raise SystemExit(f"--dp {args.dp} must be >= 1 and divide {n} devices")
@@ -280,6 +290,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint each block (memory for FLOPs)")
     parser.add_argument("--force-cpu", action="store_true")
+    # dp only; no --no-error-feedback here — PjitEngine's compressed sync
+    # is stateless (no residual to carry), unlike DataParallel's
+    add_grad_compress_cli(parser, error_feedback=False)
     return parser
 
 

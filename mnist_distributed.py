@@ -19,7 +19,7 @@ Real multi-host runs initialize via tpu_sandbox.runtime.bootstrap
 
 import argparse
 
-from tpu_sandbox.utils.cli import add_checkpoint_cli
+from tpu_sandbox.utils.cli import add_checkpoint_cli, add_grad_compress_cli
 
 IMAGE_SHAPE = [3000, 3000]
 
@@ -102,7 +102,8 @@ def train(args, world_size):
             state = ckpt.restore(args.ckpt_dir, state)
             print(f"resumed from step {int(state.step)}")
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
-                      zero=args.zero)
+                      zero=args.zero, grad_compress=args.grad_compress,
+                      error_feedback=not args.no_error_feedback)
     dstate = dp.shard_state(state)
 
     def step(s, images_np, labels_np):
@@ -201,7 +202,8 @@ def train_multiprocess_worker(args, world_size):
                 )
 
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
-                      zero=args.zero)
+                      zero=args.zero, grad_compress=args.grad_compress,
+                      error_feedback=not args.no_error_feedback)
     dstate = dp.shard_state(state)
     trainer = Trainer(dp.train_step, log_every=args.log_every, log_rank=0,
                       verbose=rank == 0)
@@ -307,7 +309,9 @@ def train_elastic_worker(args, world_size):
     # donate=False: the non-finite guard keeps the PREVIOUS state when an
     # update is discarded, which donated (invalidated) buffers cannot do
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
-                      zero=args.zero, donate=False)
+                      zero=args.zero, donate=False,
+                      grad_compress=args.grad_compress,
+                      error_feedback=not args.no_error_feedback)
 
     # per-boundary preemption vote: OR this rank's flag across the world
     # through a real collective, so every rank reaches the same stop
@@ -333,6 +337,7 @@ def train_elastic_worker(args, world_size):
                 os.environ.get("TPU_SANDBOX_COMMIT_TIMEOUT", 60.0)
             ),
             generation=gen, verbose=rank == 0,
+            compress=args.ckpt_compress,
         )
     if verifier is not None:
         verifier.start()
@@ -423,6 +428,12 @@ def spawn_elastic(args, world_size):
     if args.ckpt_verify_interval:
         passthrough += ["--ckpt-verify-interval",
                         str(args.ckpt_verify_interval)]
+    if args.ckpt_compress:
+        passthrough += ["--ckpt-compress"]
+    if args.grad_compress != "none":
+        passthrough += ["--grad-compress", args.grad_compress]
+    if args.no_error_feedback:
+        passthrough += ["--no-error-feedback"]
 
     def build(gen, kv_port):
         port = find_free_port()  # fresh coordinator port per generation
@@ -491,6 +502,10 @@ def spawn_multiprocess(args, world_size):
         passthrough += ["--limit-steps", str(args.limit_steps)]
     if args.zero:
         passthrough += ["--zero"]
+    if args.grad_compress != "none":
+        passthrough += ["--grad-compress", args.grad_compress]
+    if args.no_error_feedback:
+        passthrough += ["--no-error-feedback"]
     procs = [
         subprocess.Popen(cmd_base + ["--rank", str(r)] + passthrough)
         for r in range(world_size)
@@ -579,6 +594,7 @@ def main():
                              "size allows")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
     add_checkpoint_cli(parser)
+    add_grad_compress_cli(parser)
     parser.add_argument("--force-cpu", action="store_true",
                         help="use virtual CPU devices even if an accelerator is present")
     parser.add_argument("--multiprocess", action="store_true",

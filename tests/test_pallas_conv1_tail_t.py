@@ -105,3 +105,33 @@ def test_differentiated_input_raises():
         jax.grad(loss)(jnp.float32(1.0))
     with pytest.raises(ValueError, match="ZERO input cotangent"):
         jax.grad(jax.jit(loss))(jnp.float32(1.0))
+
+
+def test_wgrad_restage_variants_agree(monkeypatch):
+    """The fused tail wgrad honors TPU_SANDBOX_WGRAD_RESTAGE like every
+    other wgrad kernel (it was hardcoded to 'gt' before): both variants
+    produce the same gradients, and the unset default is the 'gt'
+    native-dot form bitwise. jax.grad re-traces per call, so the
+    trace-time env read sees each monkeypatched value."""
+    x, k5, cb, gamma, beta = _case(seed=3)
+    f1 = k5.shape[-1]
+
+    def grads():
+        def f(k5, cb, gamma, beta):
+            out, _, _ = conv1_tail_t(x, k5, cb, gamma, beta, f1, 4)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2, 3))(k5, cb, gamma, beta)
+
+    monkeypatch.setenv("TPU_SANDBOX_WGRAD_RESTAGE", "gt")
+    g_gt = grads()
+    monkeypatch.setenv("TPU_SANDBOX_WGRAD_RESTAGE", "auto")
+    g_auto = grads()
+    monkeypatch.delenv("TPU_SANDBOX_WGRAD_RESTAGE")
+    g_default = grads()
+    for a, b, nm in zip(g_gt, g_auto, ("dk5", "dcbias", "dgamma", "dbeta")):
+        scale = float(np.max(np.abs(np.asarray(a, np.float32)))) or 1.0
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0, atol=1e-5 * scale, err_msg=nm)
+    for a, b in zip(g_gt, g_default):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -42,7 +42,7 @@ TOTAL_STEPS = 16
 WORLD = 2
 
 
-def run_elastic(ckpt_dir, fault_plan=None, timeout=600):
+def run_elastic(ckpt_dir, fault_plan=None, timeout=600, extra=()):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -50,7 +50,8 @@ def run_elastic(ckpt_dir, fault_plan=None, timeout=600):
     env["TPU_SANDBOX_TERM_TIMEOUT"] = "10"
     if fault_plan is not None:
         env["TPU_SANDBOX_FAULT_PLAN"] = json.dumps(fault_plan)
-    cmd = [sys.executable, str(SCRIPT), *COMMON, "--ckpt-dir", str(ckpt_dir)]
+    cmd = [sys.executable, str(SCRIPT), *COMMON, *extra,
+           "--ckpt-dir", str(ckpt_dir)]
     return subprocess.run(
         cmd, env=env, cwd=REPO, capture_output=True, text=True,
         timeout=timeout,
@@ -140,3 +141,36 @@ def test_corrupt_sealed_shard_detected_and_fallen_past(tmp_path):
     from tools.verify_ckpt import main as verify_main
 
     assert verify_main([str(rot_dir)]) == 0
+
+
+def test_grad_compress_residual_survives_crash(tmp_path):
+    """--grad-compress int8 under the same kill_during_commit fault: the
+    error-feedback residual is real training state (dropping it on
+    resume would re-inject stale quantization error), so it rides the
+    sharded checkpoint as a per-rank leaf and the crashed run's final
+    shards — residual included — are bitwise-identical to an
+    uninterrupted run's."""
+    extra = ("--grad-compress", "int8")
+    ref_dir = tmp_path / "ref"
+    r = run_elastic(ref_dir, extra=extra)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    ref = final_shards(ref_dir)
+    res_keys = [k for k in ref if "grad_residual" in k[1]]
+    assert res_keys, sorted(k[1] for k in ref)
+    # both ranks checkpoint their own residual, and it is nonzero (the
+    # quantizer always drops SOMETHING on real gradients)
+    assert {k[0] for k in res_keys} == set(range(WORLD))
+    assert any(np.abs(ref[k]).max() > 0 for k in res_keys)
+
+    crash_dir = tmp_path / "crash"
+    r = run_elastic(
+        crash_dir,
+        fault_plan=[{"rank": 0, "step": 4, "action": "kill_during_commit"}],
+        extra=extra,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "gen1:failure" in r.stdout and "gen2:ok" in r.stdout, r.stdout
+    assert "resumed from step 2" in r.stdout, r.stdout
+
+    assert_bitwise_same(ref, final_shards(crash_dir))

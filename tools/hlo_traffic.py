@@ -33,7 +33,10 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)            # import aot_v5e as a sibling
 sys.path.insert(0, os.path.dirname(_HERE))  # import tpu_sandbox from the repo
 
-from aot_v5e import compile_step, make_topology  # noqa: E402
+# aot_v5e (and with it libtpu topologies) is imported lazily in main():
+# the pure-text analyzers below (shape_bytes / collective_bytes) must be
+# importable on CPU-only boxes — bench.py's grad-compress traffic metric
+# runs them against a CPU SPMD compile.
 
 _SHAPE = re.compile(r"(\w+)\[([\d,]*)\](?:\{([^}]*)\})?")
 _BITS = {
@@ -70,6 +73,45 @@ def shape_bytes(text: str) -> int:
             n *= d
         total += n * bits // 8
     return total
+
+
+#: Cross-replica collective opcodes (plus their async -start halves; the
+#: -done halves carry no payload of their own and are skipped).
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute",
+)
+
+_INST = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+([a-z][\w\-]*)\((.*)$"
+)
+
+
+def collective_bytes(hlo_text: str) -> dict:
+    """Per-participant payload bytes of the cross-replica collectives in an
+    optimized HLO module, bucketed by opcode.
+
+    Counts each collective instruction's OPERAND bytes — the data every
+    participant contributes to the fabric per step (for all-gather that is
+    the local shard, for all-reduce the full buffer; ring-algorithm wire
+    amplification is deliberately not modeled, so ratios between compiles
+    are like-for-like). Scans every computation, not just ENTRY: shard_map
+    bodies compile to nested computations.
+
+    Returns ``{"total": int, "by_opcode": {opcode: int}}``.
+    """
+    by_opcode: dict[str, int] = collections.defaultdict(int)
+    for line in hlo_text.splitlines():
+        m = _INST.match(line)
+        if not m:
+            continue
+        _shape, opcode, rest = m.groups()
+        base = opcode[:-6] if opcode.endswith("-start") else opcode
+        if base not in _COLLECTIVES or opcode.endswith("-done"):
+            continue
+        # operand list ends at the first ')' (shapes carry no parens)
+        by_opcode[base] += shape_bytes(rest.split(")")[0])
+    return {"total": sum(by_opcode.values()), "by_opcode": dict(by_opcode)}
 
 
 _OPNAME = re.compile(r'op_name="jit\(train_step\)/([^"]*)"')
@@ -118,6 +160,8 @@ def main():
     if args.hlo_file:
         text = open(args.hlo_file).read()
     else:
+        from aot_v5e import compile_step, make_topology
+
         topo = make_topology()
         compiled = compile_step(topo, args.plan, args.batch, args.image_size)
         text = compiled.as_text()

@@ -16,7 +16,9 @@ s1/s2 the row math needs), then ONE kernel recomputes each row's
 tail-backward dy IN VMEM (identical math to _bwd_apply_kernel,
 including the rounded-relu recompute, exact 0.5/0.5 pool tie splitting,
 and the bf16 rounding the HBM tensor would have applied) and feeds it
-straight into the sparse conv1 wgrad dot (the gt-restaged native form).
+straight into the sparse conv1 wgrad dot (restaged per
+TPU_SANDBOX_WGRAD_RESTAGE like every other wgrad kernel: 'gt' native
+form by default, 'auto' to let Mosaic restage the ragged tile).
 g never exists in HBM; reads are y1 + pooled-cotangent + x instead of
 g + x — the fused backward's traffic is ~12.7 GB vs ~22.1 GB unfused
 across the reduce+apply+wgrad trio.
@@ -65,6 +67,7 @@ from tpu_sandbox.ops.pallas_conv_t import (
     _VMEM_LIMIT,
     _halo_specs,
     _row_getter,
+    wgrad_restage,
 )
 
 
@@ -72,11 +75,16 @@ def _wgrad_tail_kernel(x_ref, up_ref, dn_ref, y1_ref, gp_ref,
                        a_ref, b_ref, sel_ref, mu_ref, inv_ref,
                        gi_ref, c1_ref, c2_ref,
                        dw_ref, db_ref, dw_scr, db_scr,
-                       *, bh: int, nblk: int, co: int, blk: int):
+                       *, bh: int, nblk: int, co: int, blk: int,
+                       gt: bool):
     """Per row: the tail backward's dy (exact _bwd_apply_kernel math,
     rounded to the activation dtype like the HBM tensor would be), then
-    the sparse conv1 wgrad dot against the union tap tile (gt restage:
-    native [NT, W] x [W, CO])."""
+    the sparse conv1 wgrad dot against the union tap tile. Same two
+    restage variants as pallas_conv5_t._wgrad_kernel: ``gt=True``
+    transposes dy ([CO, W] — 128-aligned) and runs the native
+    tile [NT, W] x dyT [W, CO] -> dW [NT, CO]; ``gt=False`` leaves the
+    lane-lane contraction to Mosaic (which restages the ragged [NT, W]
+    tile instead)."""
     n, i = pl.program_id(0), pl.program_id(1)
 
     @pl.when(jnp.logical_and(n == 0, i == 0))
@@ -94,11 +102,19 @@ def _wgrad_tail_kernel(x_ref, up_ref, dn_ref, y1_ref, gp_ref,
         g_row = dy.astype(x_ref.dtype)          # the rounding HBM applied
         db_scr[:] = db_scr[:] + jnp.sum(
             g_row.astype(jnp.float32), axis=1, keepdims=True)
-        dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
-            _tap_tile_u(get, r), g_row.T,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if gt:
+            acc = jax.lax.dot_general(           # [NT, CO], native form
+                _tap_tile_u(get, r), g_row.T,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            acc = jax.lax.dot_general(           # [CO, NT]
+                g_row, _tap_tile_u(get, r),
+                (((1,), (1,)), ((), ())),        # contract W on both
+                preferred_element_type=jnp.float32,
+            )
+        dw_scr[:] = dw_scr[:] + acc
 
     @pl.when(jnp.logical_and(n == pl.num_programs(0) - 1, i == nblk - 1))
     def _emit():
@@ -109,10 +125,12 @@ def _wgrad_tail_kernel(x_ref, up_ref, dn_ref, y1_ref, gp_ref,
 def _pick_block_h_fused(h: int, wd: int, c16: int, cbig: int,
                         cpool: int) -> int:
     """VMEM-budgeted rows per block for the fused kernel: per-row it
-    streams x + y1 + g_pool blocks (double-buffered bf16) and keeps
-    ~6 [cbig, W] f32 tail-backward intermediates plus the tap tile and
-    dw scratch live."""
-    per_bh = wd * (c16 + cbig + cpool) * 2 * 2
+    streams the x block THREE times (the x/up/dn halo triple of
+    _halo_specs each stages its own double-buffered copy — counting it
+    once under-budgets VMEM by 4*wd*c16*bh bytes per block) plus y1 +
+    g_pool (all double-buffered bf16), and keeps ~6 [cbig, W] f32
+    tail-backward intermediates plus the tap tile and dw scratch live."""
+    per_bh = wd * (3 * c16 + cbig + cpool) * 2 * 2
     fixed = wd * cbig * 4 * 6 + wd * NT * 4 + NT * cbig * 4
     cap = max(1, int((28_000_000 - fixed) // max(per_bh, 1)))
     for bh in (15, 10, 6, 5, 3, 2, 1):
@@ -122,21 +140,28 @@ def _pick_block_h_fused(h: int, wd: int, c16: int, cbig: int,
 
 
 def _fused_wgrad(x, y1, g_pool, a_col, b_col, sel, mu_col, inv_col,
-                 gi_col, c1_col, c2_col, co, blk, interpret):
+                 gi_col, c1_col, c2_col, co, blk, interpret,
+                 restage=None):
+    """``restage`` as in conv3x3_t_wgrad ('gt' native-dot variant is the
+    r05 default; None resolves TPU_SANDBOX_WGRAD_RESTAGE at trace
+    time). Returns dw1 [cbig, NT] regardless of variant — gt stores the
+    native [NT, cbig] and transposes on the way out."""
+    gt = wgrad_restage(restage) == "gt"
     n, h, c16, wd = x.shape
     assert c16 == R * R, (c16,)
     cbig = y1.shape[2]
     cpool = g_pool.shape[2]
     bh = _pick_block_h_fused(h, wd, c16, cbig, cpool)
     nblk = h // bh
+    dw_shape = (NT, cbig) if gt else (cbig, NT)
 
     def vec():
         return pl.BlockSpec((cbig, 1), lambda n, i: (0, 0))
 
     dw, db = pl.pallas_call(
         functools.partial(_wgrad_tail_kernel, bh=bh, nblk=nblk,
-                          co=co, blk=blk),
-        out_shape=(jax.ShapeDtypeStruct((NT, cbig), jnp.float32),
+                          co=co, blk=blk, gt=gt),
+        out_shape=(jax.ShapeDtypeStruct(dw_shape, jnp.float32),
                    jax.ShapeDtypeStruct((cbig, 1), jnp.float32)),
         grid=(n, nblk),
         in_specs=_halo_specs(bh, nblk, c16, wd) + [
@@ -146,10 +171,10 @@ def _fused_wgrad(x, y1, g_pool, a_col, b_col, sel, mu_col, inv_col,
             pl.BlockSpec(sel.shape, lambda n, i: (0, 0)),
             vec(), vec(), vec(), vec(), vec(),
         ],
-        out_specs=(pl.BlockSpec((NT, cbig), lambda n, i: (0, 0)),
+        out_specs=(pl.BlockSpec(dw_shape, lambda n, i: (0, 0)),
                    pl.BlockSpec((cbig, 1), lambda n, i: (0, 0))),
         scratch_shapes=[
-            pltpu.VMEM((NT, cbig), jnp.float32),
+            pltpu.VMEM(dw_shape, jnp.float32),
             pltpu.VMEM((cbig, 1), jnp.float32),
         ],
         compiler_params=tpu_compiler_params(pltpu)(
@@ -159,7 +184,7 @@ def _fused_wgrad(x, y1, g_pool, a_col, b_col, sel, mu_col, inv_col,
         interpret=default_interpret(interpret),
     )(x, x, x, y1, g_pool, a_col, b_col, sel, mu_col, inv_col,
       gi_col, c1_col, c2_col)
-    return dw.T, db
+    return (dw.T if gt else dw), db
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))

@@ -1,4 +1,7 @@
-from tpu_sandbox.parallel.collectives import CollectiveGroup  # noqa: F401
+from tpu_sandbox.parallel.collectives import (  # noqa: F401
+    CollectiveGroup,
+    CompressedAllReduce,
+)
 from tpu_sandbox.parallel.data_parallel import DataParallel  # noqa: F401
 from tpu_sandbox.parallel.expert import MoeMlp  # noqa: F401
 from tpu_sandbox.parallel.pipeline import PipelineParallel  # noqa: F401

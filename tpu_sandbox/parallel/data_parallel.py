@@ -30,11 +30,13 @@ import jax
 
 from tpu_sandbox.utils.compat import shard_map
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.parallel.collectives import CompressedAllReduce
 from tpu_sandbox.train.state import TrainState
 
 
@@ -59,6 +61,8 @@ class DataParallel:
         average_loss: bool = False,
         zero: bool = False,
         donate: bool = True,
+        grad_compress: str | CompressedAllReduce = "none",
+        error_feedback: bool = True,
     ):
         """``zero=True`` is ZeRO-1 (optimizer-state sharding): optimizer
         state lives sharded over the data axis (dim 0, leaves whose leading
@@ -75,7 +79,20 @@ class DataParallel:
         e.g. ``optax.clip_by_global_norm`` (a norm over ALL grads) — would
         silently compute per-block norms; transforms whose state does not
         mirror param shapes (e.g. adafactor's factored moments) are
-        rejected by a structural check at shard time."""
+        rejected by a structural check at shard time.
+
+        ``grad_compress`` compresses the gradient sync's wire payload:
+        ``'none'`` (bitwise-identical to the uncompressed path), ``'bf16'``
+        (cast-pmean-cast, 2x), or ``'int8'`` (block-scaled two-shot
+        exchange, ~4x — see collectives.CompressedAllReduce). With int8,
+        ``error_feedback=True`` carries a param-shaped fp32 residual in
+        ``TrainState.grad_residual`` (one per rank, sharded like BN stats)
+        so quantization error is re-injected next step; it checkpoints as a
+        per-rank shard so elastic resume is bitwise. Under ``zero`` the
+        compressed mean replaces BOTH the psum_scatter and pmean branches:
+        wire compression is kept, but the scatter-only half-volume trick is
+        traded away (each rank slices its block from the full compressed
+        mean)."""
         if axis not in mesh.axis_names:
             raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
         self.model = model
@@ -86,6 +103,13 @@ class DataParallel:
         self.image_size = image_size
         self.average_loss = average_loss
         self.zero = zero
+        if isinstance(grad_compress, CompressedAllReduce):
+            self.compress = grad_compress
+        else:
+            self.compress = CompressedAllReduce(
+                mode=str(grad_compress) if grad_compress else "none",
+                error_feedback=error_feedback,
+            )
         self._build(donate)
 
     def _dim0_sharded(self, leaf) -> bool:
@@ -132,6 +156,11 @@ class DataParallel:
             params=jax.tree.map(lambda _: P(), state.params),
             batch_stats=jax.tree.map(lambda _: P(self.axis), state.batch_stats),
             opt_state=opt_specs,
+            # error-feedback residuals are rank-local like BN stats: one
+            # param-shaped copy per rank behind a leading mesh-axis dim
+            grad_residual=jax.tree.map(
+                lambda _: P(self.axis), state.grad_residual
+            ),
         )
 
     def shard_state(
@@ -153,6 +182,19 @@ class DataParallel:
         reference's implicit contract), and each process materializes only
         its addressable shards via ``make_array_from_callback``.
         """
+        if self.compress.needs_residual and state.grad_residual is None:
+            # first placement of a compression-naive state: start the
+            # error-feedback residual at zero (its mathematical identity)
+            state = state.replace(
+                grad_residual=jax.tree.map(
+                    lambda p: (
+                        np.zeros((self.size, *np.shape(p)), np.float32)
+                        if stats_expanded
+                        else np.zeros(np.shape(p), np.float32)
+                    ),
+                    state.params,
+                )
+            )
         if stats_expanded:
             expanded = state
         else:
@@ -160,7 +202,11 @@ class DataParallel:
                 batch_stats=jax.tree.map(
                     lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
                     state.batch_stats,
-                )
+                ),
+                grad_residual=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (self.size, *x.shape)),
+                    state.grad_residual,
+                ),
             )
         specs = self._specs(expanded)
         if jax.process_count() == 1:
@@ -182,9 +228,29 @@ class DataParallel:
         return jax.tree.map(put, expanded, specs)
 
     def unshard_state(self, state: TrainState, rank: int = 0) -> TrainState:
-        """Single-device view: params as-is, rank ``rank``'s BN stats."""
+        """Single-device view: params as-is, rank ``rank``'s BN stats.
+
+        The error-feedback residual is dropped: it is a per-rank sync
+        buffer whose single-rank slice means nothing to a resumed run
+        (re-placement restarts it at zero). Exact residual resume is the
+        sharded elastic checkpoint's job, which saves every rank's copy."""
         return state.replace(
-            batch_stats=jax.tree.map(lambda x: x[rank], state.batch_stats)
+            batch_stats=jax.tree.map(lambda x: x[rank], state.batch_stats),
+            grad_residual=None,
+        )
+
+    def checkpoint_template(self, template: TrainState) -> TrainState:
+        """Host-side restore template with the error-feedback residual slot
+        attached (zeros, param-shaped). Checkpoint backends restore only
+        leaves the template names, so a template built before the first
+        step (residual still None) would silently drop every rank's saved
+        residual on resume — attach the slot up front instead."""
+        if not self.compress.needs_residual or template.grad_residual is not None:
+            return template
+        return template.replace(
+            grad_residual=jax.tree.map(
+                lambda p: np.zeros(np.shape(p), np.float32), template.params
+            )
         )
 
     def checkpoint_spec(self, state: TrainState) -> TrainState:
@@ -216,6 +282,7 @@ class DataParallel:
         model, tx, axis = self.model, self.tx, self.axis
         image_size, average_loss = self.image_size, self.average_loss
         zero, size, dim0_sharded = self.zero, self.size, self._dim0_sharded
+        compress = self.compress
 
         def loss_fn(params, batch_stats, images, labels):
             variables = {"params": params}
@@ -238,6 +305,20 @@ class DataParallel:
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, local_stats, images, labels
             )
+            new_residual = state.grad_residual
+            if compress.mode != "none":
+                # Compressed sync happens ONCE here for every leaf; the
+                # branches below then consume already-mean'd grads. (Under
+                # ZeRO this supersedes the psum_scatter half-volume trick —
+                # the wire carries the compressed payload instead.)
+                local_res = (
+                    jax.tree.map(lambda x: x[0], state.grad_residual)
+                    if compress.needs_residual
+                    else None
+                )
+                grads, new_res = compress.pmean_tree(grads, axis, size, local_res)
+                if compress.needs_residual:
+                    new_residual = jax.tree.map(lambda x: x[None], new_res)
             if zero:
                 # ZeRO-1: reduce-SCATTER each eligible gradient (every rank
                 # receives only its dim-0 block of the mean — the collective
@@ -256,14 +337,21 @@ class DataParallel:
                 params_blk = jax.tree.map(
                     lambda p, s: blk(p) if s else p, state.params, sharded
                 )
-                grads_blk = jax.tree.map(
-                    lambda g, s: (
-                        lax.psum_scatter(g, axis, scatter_dimension=0,
-                                         tiled=True) / size
-                        if s else lax.pmean(g, axis)
-                    ),
-                    grads, sharded,
-                )
+                if compress.mode != "none":
+                    # already mean'd by the compressed sync above — each
+                    # rank just slices its own block
+                    grads_blk = jax.tree.map(
+                        lambda g, s: blk(g) if s else g, grads, sharded
+                    )
+                else:
+                    grads_blk = jax.tree.map(
+                        lambda g, s: (
+                            lax.psum_scatter(g, axis, scatter_dimension=0,
+                                             tiled=True) / size
+                            if s else lax.pmean(g, axis)
+                        ),
+                        grads, sharded,
+                    )
                 updates, new_opt = tx.update(
                     grads_blk, state.opt_state, params_blk
                 )
@@ -275,10 +363,11 @@ class DataParallel:
                     new_blk, sharded,
                 )
             else:
-                # THE data-parallel step: mean grads across ranks. XLA
-                # overlaps this with the rest of backprop (DDP's bucketing,
-                # compiled).
-                grads = lax.pmean(grads, axis)
+                if compress.mode == "none":
+                    # THE data-parallel step: mean grads across ranks. XLA
+                    # overlaps this with the rest of backprop (DDP's
+                    # bucketing, compiled).
+                    grads = lax.pmean(grads, axis)
                 updates, new_opt = tx.update(
                     grads, state.opt_state, state.params
                 )
@@ -290,6 +379,7 @@ class DataParallel:
                 params=new_params,
                 batch_stats=jax.tree.map(lambda x: x[None], new_stats),
                 opt_state=new_opt,
+                grad_residual=new_residual,
             )
             return new_state, loss[None]
 
@@ -320,3 +410,11 @@ class DataParallel:
         if self._jitted is None:
             self._jitted = self._compile_for(state)
         return self._jitted(state, images, labels)
+
+    def lower_step(self, state: TrainState, images, labels):
+        """AOT-lower the train step without executing it — the hook the
+        collective-traffic accounting uses (``.compile().as_text()`` keeps
+        the cross-replica collectives with inline operand shapes)."""
+        if self._jitted is None:
+            self._jitted = self._compile_for(state)
+        return self._jitted.lower(state, images, labels)

@@ -37,6 +37,10 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.parallel.collectives import (
+    CompressedAllReduce,
+    as_compress_policy,
+)
 from tpu_sandbox.train.state import TrainState
 
 Rule = tuple[str, P]
@@ -154,6 +158,10 @@ def state_specs(state: TrainState, rules: Sequence[Rule],
         params=pspecs,
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=jax.tree_util.tree_map_with_path(opt_spec, state.opt_state),
+        # this engine never threads an error-feedback residual (grad
+        # compression here is stateless); mirror the (normally empty) node
+        # so pytree structures line up whatever state is handed in
+        grad_residual=jax.tree.map(lambda _: P(), state.grad_residual),
     )
 
 
@@ -182,6 +190,7 @@ class PjitEngine:
         zero_axis: str | None = None,
         fsdp_axis: str | None = None,
         donate: bool = True,
+        grad_compress: str | CompressedAllReduce = "none",
     ):
         if task not in ("image", "lm"):
             raise ValueError(f"task must be 'image' or 'lm', got {task!r}")
@@ -226,6 +235,32 @@ class PjitEngine:
         self.zero_axis = zero_axis
         self.fsdp_axis = fsdp_axis
         self.donate = donate
+        # Compressed grad sync needs the gradients to cross exactly ONE
+        # mesh axis (the batch axis) in a known place, so it is spelled as
+        # an explicit shard_map wrapped around the grad computation. That
+        # only composes with pure data parallelism: under TP rules / FSDP /
+        # spatial input specs, XLA owns where the collectives go and we
+        # cannot intercept them. zero_axis is fine (the sharding mismatch
+        # is between replicated grads and sharded moments, downstream of
+        # the sync). Stateless here: no error-feedback residual — use
+        # DataParallel for int8 + error feedback.
+        self.grad_compress = as_compress_policy(grad_compress)
+        if self.grad_compress.mode != "none":
+            if self.rules:
+                raise ValueError(
+                    "grad_compress composes only with pure data parallelism; "
+                    "drop the TP rules or use grad_compress='none'"
+                )
+            if self.fsdp_axis is not None:
+                raise ValueError(
+                    "grad_compress does not compose with fsdp_axis (FSDP's "
+                    "reduce-scatter is compiler-inserted)"
+                )
+            if self.input_spec != P(self.batch_axis):
+                raise ValueError(
+                    f"grad_compress needs input_spec == P({self.batch_axis!r}) "
+                    f"(batch-only sharding), got {self.input_spec}"
+                )
         self._jitted: Callable | None = None
 
     def _state_specs(self, state: TrainState) -> TrainState:
@@ -288,23 +323,74 @@ class PjitEngine:
                     "batch_stats", {}
                 )
 
-        def step(state: TrainState, images, labels):
-            if image_size is not None and self.task == "image":
-                from tpu_sandbox.train import prepare_inputs
-                images = prepare_inputs(model, images, image_size)
-            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, state.batch_stats, images, labels
+        compress = self.grad_compress
+        if compress.mode != "none":
+            if jax.tree.leaves(state.batch_stats):
+                raise ValueError(
+                    "grad_compress under PjitEngine requires a BN-free "
+                    "model: batch stats mutate per data shard inside the "
+                    "grad shard_map and cannot be returned replicated. Use "
+                    "DataParallel (per-replica BN) instead."
+                )
+            from jax import lax
+
+            from tpu_sandbox.utils.compat import shard_map
+
+            axis = self.batch_axis
+            size = self.mesh.shape[axis]
+
+            def grads_body(params, images, labels):
+                (loss, _), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, {}, images, labels)
+                grads, _ = compress.pmean_tree(grads, axis, size, None)
+                return lax.pmean(loss, axis), grads
+
+            grads_fn = shard_map(
+                grads_body,
+                mesh=self.mesh,
+                in_specs=(P(), P(axis), P(axis)),
+                out_specs=(P(), P()),
+                check_vma=False,  # grads are replicated by the compressed
+                # pmean; the static analysis can't see through it
             )
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            return (
-                state.replace(
-                    step=state.step + 1,
-                    params=optax.apply_updates(state.params, updates),
-                    batch_stats=new_stats,
-                    opt_state=new_opt,
-                ),
-                loss,
-            )
+
+            def step(state: TrainState, images, labels):
+                if image_size is not None and self.task == "image":
+                    from tpu_sandbox.train import prepare_inputs
+                    images = prepare_inputs(model, images, image_size)
+                loss, grads = grads_fn(state.params, images, labels)
+                updates, new_opt = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                return (
+                    state.replace(
+                        step=state.step + 1,
+                        params=optax.apply_updates(state.params, updates),
+                        opt_state=new_opt,
+                    ),
+                    loss,
+                )
+
+        else:
+
+            def step(state: TrainState, images, labels):
+                if image_size is not None and self.task == "image":
+                    from tpu_sandbox.train import prepare_inputs
+                    images = prepare_inputs(model, images, image_size)
+                (loss, new_stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, state.batch_stats, images, labels)
+                updates, new_opt = tx.update(grads, state.opt_state, state.params)
+                return (
+                    state.replace(
+                        step=state.step + 1,
+                        params=optax.apply_updates(state.params, updates),
+                        batch_stats=new_stats,
+                        opt_state=new_opt,
+                    ),
+                    loss,
+                )
 
         specs = self._state_specs(state)
         to_sh = lambda tree: jax.tree.map(self._sharding, tree)  # noqa: E731

@@ -19,6 +19,7 @@ import shutil
 import tempfile
 import threading
 import time
+import zipfile
 from pathlib import Path
 
 import jax
@@ -61,7 +62,11 @@ def _layout_error(directory: Path, found: str) -> ValueError:
         "model; re-save from the original code or re-permute fc/kernel "
         "rows (h,w,c)->(h,c,w)) or unrecognized subdirectories this "
         "guard conservatively refuses to stamp over (point `directory` "
-        "at a dedicated checkpoint dir)."
+        "at a dedicated checkpoint dir). One benign cause: a run "
+        "interrupted during its FIRST save leaves only "
+        "'*.orbax-checkpoint-tmp-*' debris directories behind — if that "
+        "is all you see here, just delete them and re-run; no fc "
+        "re-permutation is involved."
     )
 
 
@@ -415,6 +420,25 @@ def _sha256_file(path: Path) -> str:
     return h.hexdigest()
 
 
+def _npz_raw_bytes(path: Path) -> int | None:
+    """Uncompressed payload size of an npz without inflating it: sum each
+    member's .npy header (shape x dtype). None if any header is unreadable
+    — callers record sizes opportunistically, never fail a commit on it."""
+    try:
+        total = 0
+        with zipfile.ZipFile(path) as z:
+            for name in z.namelist():
+                with z.open(name) as f:
+                    version = np.lib.format.read_magic(f)
+                    shape, _, dtype = np.lib.format._read_array_header(
+                        f, version
+                    )
+                    total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        return total
+    except Exception:
+        return None
+
+
 def _step_dir_name(step: int) -> str:
     return f"step-{int(step):08d}"
 
@@ -494,7 +518,14 @@ class ShardedCheckpoint:
         poll: float = 0.02,
         generation: int | str | None = None,
         verbose: bool = True,
+        compress: bool = False,
     ):
+        """``compress=True`` writes shard files with zlib-deflated npz
+        (``np.savez_compressed``). Restore is format-agnostic (``np.load``
+        inflates transparently, so mixed-compression histories restore
+        fine), and the SHA-256 in each claim/manifest is still over the
+        bytes ON DISK — integrity verification never decompresses. The
+        manifest records both on-disk and raw sizes per shard."""
         if not 0 <= rank < world_size:
             raise ValueError(f"rank {rank} outside world of {world_size}")
         self.directory = Path(directory).absolute()
@@ -506,6 +537,7 @@ class ShardedCheckpoint:
         self.poll = poll
         self.generation = str(generation) if generation is not None else "0"
         self.verbose = verbose
+        self.compress = compress
 
     # -- paths / keys ------------------------------------------------------
 
@@ -590,9 +622,10 @@ class ShardedCheckpoint:
         ))
         final = sd / self._shard_name(self.rank)
         fd, tmp = tempfile.mkstemp(dir=sd, suffix=".npz.tmp")
+        saver = np.savez_compressed if self.compress else np.savez
         try:
             with os.fdopen(fd, "wb") as f:
-                np.savez(f, **arrays)
+                saver(f, **arrays)
             os.replace(tmp, final)
         except BaseException:
             Path(tmp).unlink(missing_ok=True)
@@ -602,6 +635,8 @@ class ShardedCheckpoint:
             "file": final.name,
             "sha256": _sha256_file(final),
             "bytes": final.stat().st_size,
+            "raw_bytes": int(sum(a.nbytes for a in arrays.values())),
+            "compressed": bool(self.compress),
         }
         if self.kv is not None:
             # TTL'd: a claim that outlives its commit window by far is
@@ -674,6 +709,8 @@ class ShardedCheckpoint:
                             "file": f.name,
                             "sha256": _sha256_file(f),
                             "bytes": f.stat().st_size,
+                            "raw_bytes": _npz_raw_bytes(f),
+                            "compressed": bool(self.compress),
                         }
             if len(claims) == self.world_size:
                 return [claims[r] for r in range(self.world_size)]

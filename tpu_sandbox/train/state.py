@@ -18,10 +18,15 @@ from flax import struct
 
 @struct.dataclass
 class TrainState:
+    # grad_residual is the error-feedback buffer of the compressed gradient
+    # sync (parallel/collectives.py::CompressedAllReduce): a param-shaped
+    # fp32 pytree per rank, or None (an empty pytree node, so states built
+    # before/without compression keep their leaf structure bit-for-bit).
     step: jax.Array
     params: Any
     batch_stats: Any
     opt_state: Any
+    grad_residual: Any = None
 
     @classmethod
     def create(cls, model, rng, sample_input, tx: optax.GradientTransformation):

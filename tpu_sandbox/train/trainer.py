@@ -423,6 +423,7 @@ def build_elastic_checkpoint(
     generation: int | str | None = None,
     keep: int = 3,
     verbose: bool = True,
+    compress: bool = False,
 ):
     """Build the (save_fn, restore_fn, verifier) triple ``train_resumable``
     consumes, picking the checkpoint backend for an elastic run.
@@ -454,6 +455,12 @@ def build_elastic_checkpoint(
 
     if sharded is None:
         sharded = True
+    # Engines with step-persistent sync state (the compressed-gradient
+    # error-feedback residual) extend the restore template here: leaves a
+    # template does not name are never restored, so this must run before
+    # either backend captures it.
+    if hasattr(dp, "checkpoint_template"):
+        template = dp.checkpoint_template(template)
     if dp.zero and not sharded:
         raise ValueError(
             "ZeRO optimizer-state sharding needs the sharded checkpoint "
@@ -484,7 +491,7 @@ def build_elastic_checkpoint(
     sc = ShardedCheckpoint(
         directory, rank=rank, world_size=world_size, kv=kv, keep=keep,
         commit_timeout=commit_timeout, generation=generation,
-        verbose=verbose,
+        verbose=verbose, compress=compress,
     )
 
     def save_fn(dstate, step, epoch, offset):

@@ -42,6 +42,30 @@ def add_checkpoint_cli(parser) -> None:
                         help="with sharded checkpoints: rank 0 re-hashes "
                              "older sealed steps every SEC seconds in the "
                              "background (0 = off)")
+    parser.add_argument("--ckpt-compress", action="store_true",
+                        help="with --ckpt-sharded: zlib-deflate each shard "
+                             "file (np.savez_compressed); manifests record "
+                             "on-disk AND raw sizes, checksums stay over "
+                             "the bytes on disk")
+
+
+def add_grad_compress_cli(parser, error_feedback: bool = True) -> None:
+    """Register the gradient-compression flag group (same single-site
+    contract as the checkpoint group: launchers and their respawned
+    workers re-parse these exact flags)."""
+    parser.add_argument("--grad-compress", choices=["none", "bf16", "int8"],
+                        default="none",
+                        help="compress the data-parallel gradient sync: "
+                             "bf16 cast (2x wire payload reduction) or "
+                             "int8 block-scaled two-shot exchange (~4x); "
+                             "'none' is bitwise-identical to the "
+                             "uncompressed path")
+    if error_feedback:
+        parser.add_argument("--no-error-feedback", action="store_true",
+                            help="with --grad-compress int8: drop the "
+                                 "error-feedback residual (saves one "
+                                 "param-sized fp32 buffer per rank, loses "
+                                 "the fp32-tracking convergence guarantee)")
 
 
 def _request_cpu_devices(n: int) -> None:
