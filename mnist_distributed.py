@@ -19,7 +19,11 @@ Real multi-host runs initialize via tpu_sandbox.runtime.bootstrap
 
 import argparse
 
-from tpu_sandbox.utils.cli import add_checkpoint_cli, add_grad_compress_cli
+from tpu_sandbox.utils.cli import (
+    add_checkpoint_cli,
+    add_elastic_cli,
+    add_grad_compress_cli,
+)
 
 IMAGE_SHAPE = [3000, 3000]
 
@@ -236,6 +240,7 @@ def train_elastic_worker(args, world_size):
     from tpu_sandbox.runtime.kvstore import KVClient
     from tpu_sandbox.train import (
         PREEMPTED_EXIT_CODE,
+        ElasticEnv,
         Preempted,
         PreemptionHandler,
         TrainState,
@@ -244,6 +249,7 @@ def train_elastic_worker(args, world_size):
     )
 
     rank = args.rank
+    eenv = ElasticEnv.from_env()  # generation + owning host agent (if any)
     kv = KVClient(port=int(args.kv_port))
     hb = Heartbeat(kv, rank, interval=0.5).start()
     preemption = PreemptionHandler(kv)
@@ -251,10 +257,12 @@ def train_elastic_worker(args, world_size):
     injector = None
     if plan.faults:
         # hang_heartbeat: stop beating but stay alive — exercises the
-        # supervisor's watchdog (wedged-not-dead) path
+        # supervisor's watchdog (wedged-not-dead) path; agent_id routes
+        # kill_agent/partition_host to this rank's host agent's mailbox
         injector = FaultInjector(
             plan, rank, kv,
             on_hang_heartbeat=lambda: hb.stop(deregister=False),
+            agent_id=eenv.agent_id,
         )
     wait_for_world(kv, world_size, rank, timeout=120.0)
     bootstrap.init(
@@ -322,7 +330,7 @@ def train_elastic_worker(args, world_size):
         local = np.asarray([1.0 if flag else 0.0], np.float32)
         return bool(int(_vote_sum(global_batch_from_local(mesh, local))) > 0)
 
-    gen = os.environ.get("TPU_SANDBOX_GENERATION", "1")
+    gen = eenv.generation
     restore_fn = None
     save_fn = None
     verifier = None
@@ -378,31 +386,10 @@ def train_elastic_worker(args, world_size):
     hb.stop(deregister=True)
 
 
-def spawn_elastic(args, world_size):
-    """Run the multiprocess topology under the elastic supervisor: crashes
-    and preemptions tear the generation down and relaunch it; workers
-    resume from the newest valid checkpoint with exact data order."""
-    import os
-    import sys
-
-    from tpu_sandbox.runtime.bootstrap import find_free_port
-    from tpu_sandbox.runtime.faults import FaultPlan
-    from tpu_sandbox.runtime.supervisor import (
-        RestartBudgetExceeded,
-        Supervisor,
-    )
-
-    try:
-        # fail fast here: a malformed plan would otherwise crash every
-        # worker at startup and silently burn the whole restart budget
-        FaultPlan.from_env()
-    except (TypeError, ValueError) as e:
-        raise SystemExit(f"invalid TPU_SANDBOX_FAULT_PLAN: {e}") from e
-
-    if not args.ckpt_dir:
-        print("note: --elastic without --ckpt-dir restarts from step 0 "
-              "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
-
+def _elastic_passthrough(args):
+    """The worker-facing flag subset, re-serialized for child processes
+    (shared by the single-host supervisor path and the agent topology —
+    their workers must parse identically)."""
     passthrough = [
         "-n", str(args.nodes), "-g", str(args.gpus),
         "--epochs", str(args.epochs), "--batch-size", str(args.batch_size),
@@ -434,6 +421,39 @@ def spawn_elastic(args, world_size):
         passthrough += ["--grad-compress", args.grad_compress]
     if args.no_error_feedback:
         passthrough += ["--no-error-feedback"]
+    return passthrough
+
+
+def _validate_fault_plan():
+    from tpu_sandbox.runtime.faults import FaultPlan
+
+    try:
+        # fail fast here: a malformed plan would otherwise crash every
+        # worker at startup and silently burn the whole restart budget
+        FaultPlan.from_env()
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"invalid TPU_SANDBOX_FAULT_PLAN: {e}") from e
+
+
+def spawn_elastic(args, world_size):
+    """Run the multiprocess topology under the elastic supervisor: crashes
+    and preemptions tear the generation down and relaunch it; workers
+    resume from the newest valid checkpoint with exact data order."""
+    import os
+    import sys
+
+    from tpu_sandbox.runtime.bootstrap import find_free_port
+    from tpu_sandbox.runtime.supervisor import (
+        RestartBudgetExceeded,
+        Supervisor,
+    )
+
+    _validate_fault_plan()
+    if not args.ckpt_dir:
+        print("note: --elastic without --ckpt-dir restarts from step 0 "
+              "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
+
+    passthrough = _elastic_passthrough(args)
 
     def build(gen, kv_port):
         port = find_free_port()  # fresh coordinator port per generation
@@ -462,6 +482,108 @@ def spawn_elastic(args, world_size):
     if not result.ok:
         # preempted from outside: saved state, clean stop, propagate 75
         sys.exit(result.generations[-1].exit_codes[0] or 0)
+
+
+def _agent_config_from_env(args, world_size, kv_port):
+    """AgentConfig from CLI + the same env knobs the supervisor honors,
+    plus the agent-plane extras (agent heartbeat timeout, lease TTL)."""
+    import os
+
+    from tpu_sandbox.runtime.host_agent import AgentConfig
+
+    def knob(name, default):
+        return float(os.environ.get(name, default))
+
+    return AgentConfig(
+        agent_id=args.agent_id or 0,
+        num_agents=args.agents,
+        world_size=world_size,
+        kv_port=kv_port,
+        max_restarts=args.max_restarts,
+        backoff=knob("TPU_SANDBOX_BACKOFF", 1.0),
+        heartbeat_timeout=knob("TPU_SANDBOX_WATCHDOG_TIMEOUT", 60.0),
+        grace=knob("TPU_SANDBOX_WATCHDOG_GRACE", 180.0),
+        term_timeout=knob("TPU_SANDBOX_TERM_TIMEOUT", 30.0),
+        agent_timeout=knob("TPU_SANDBOX_AGENT_TIMEOUT", 10.0),
+        lease_ttl=knob("TPU_SANDBOX_LEASE_TTL", 3.0),
+        ack_timeout=knob("TPU_SANDBOX_ACK_TIMEOUT", 60.0),
+        agent_wait=knob("TPU_SANDBOX_AGENT_WAIT", 120.0),
+    )
+
+
+def run_host_agent(args, world_size):
+    """Run ONE host agent of an --agents N job (the per-process entry the
+    AgentLauncher spawns; also usable directly, one invocation per host,
+    with --leader hosting the KV store on the first host)."""
+    import sys
+
+    from tpu_sandbox.runtime.host_agent import HostAgent
+    from tpu_sandbox.runtime.kvstore import KVServer
+
+    if args.agents < 1:
+        raise SystemExit("--agent-id requires --agents N (the topology)")
+    if not (0 <= args.agent_id < args.agents):
+        raise SystemExit(
+            f"--agent-id {args.agent_id} out of range for "
+            f"--agents {args.agents}"
+        )
+    server = None
+    if args.leader:
+        server = KVServer(port=int(args.kv_port or 0))
+        print(f"[agent {args.agent_id}] hosting KV store on port "
+              f"{server.port}", flush=True)
+        kv_port = server.port
+    elif args.kv_port:
+        kv_port = int(args.kv_port)
+    else:
+        raise SystemExit("--agent-id needs --kv-port (or --leader)")
+
+    passthrough = _elastic_passthrough(args)
+
+    def rank_cmd(gen, rank, coord_port):
+        return [sys.executable, __file__, "--elastic-worker",
+                "--port", str(coord_port), "--kv-port", str(kv_port),
+                *passthrough, "--rank", str(rank)]
+
+    cfg = _agent_config_from_env(args, world_size, kv_port)
+    try:
+        rc = HostAgent(cfg, rank_cmd).run()
+    finally:
+        if server is not None:
+            server.stop()
+    sys.exit(rc)
+
+
+def spawn_elastic_agents(args, world_size):
+    """Cross-host elastic topology, proven on one machine: an
+    AgentLauncher (the cluster-scheduler stand-in) owns the KV store and
+    spawns --agents N HostAgent processes; the agents elect a leader that
+    drives generation lifecycle, and the launcher replaces any agent that
+    dies (host replacement). See runtime/host_agent.py."""
+    import sys
+
+    from tpu_sandbox.runtime.host_agent import AgentLauncher
+
+    _validate_fault_plan()
+    if world_size % args.agents:
+        raise SystemExit(
+            f"world size {world_size} must divide by --agents {args.agents}"
+        )
+    if not args.ckpt_dir:
+        print("note: --elastic without --ckpt-dir restarts from step 0 "
+              "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
+
+    passthrough = _elastic_passthrough(args)
+
+    def agent_cmd(aid, kv_port):
+        return [sys.executable, __file__, "--elastic",
+                "--agents", str(args.agents), "--agent-id", str(aid),
+                "--kv-port", str(kv_port),
+                "--max-restarts", str(args.max_restarts), *passthrough]
+
+    rc = AgentLauncher(args.agents, agent_cmd).run()
+    if rc:
+        sys.exit(rc)
 
 
 def spawn_multiprocess(args, world_size):
@@ -600,14 +722,7 @@ def main():
     parser.add_argument("--multiprocess", action="store_true",
                         help="one OS process per rank over jax.distributed + "
                              "Gloo (the reference's actual topology)")
-    parser.add_argument("--elastic", action="store_true",
-                        help="run --multiprocess topology under the elastic "
-                             "supervisor: crashed/preempted generations are "
-                             "relaunched and resume from the newest "
-                             "checkpoint with exact data order")
-    parser.add_argument("--max-restarts", type=int, default=3,
-                        help="with --elastic: charged restarts before giving "
-                             "up (preemptions are free)")
+    add_elastic_cli(parser)
     parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--elastic-worker", action="store_true",
                         help=argparse.SUPPRESS)
@@ -621,6 +736,10 @@ def main():
         train_multiprocess_worker(args, world_size)
     elif args.elastic_worker:
         train_elastic_worker(args, world_size)
+    elif args.agent_id is not None:
+        run_host_agent(args, world_size)
+    elif args.elastic and args.agents:
+        spawn_elastic_agents(args, world_size)
     elif args.elastic:
         spawn_elastic(args, world_size)
     elif args.multiprocess:

@@ -31,7 +31,58 @@ except AttributeError:
     pass
 jax.config.update("jax_threefry_partitionable", True)
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_resource_leaks():
+    """Fail any test that leaks a live KVServer or a new non-daemon thread.
+
+    A leaked server holds its port for the rest of the session and turns
+    later find_free_port races into one-in-N flakes that reproduce only in
+    full runs; a leaked non-daemon thread hangs interpreter shutdown. Both
+    were historically found by CI timeouts instead of by the guilty test —
+    this pins the blame at the source. Daemon threads get a pass (wedged
+    Heartbeat threads are abandoned by design), and stragglers get a short
+    join grace first so tests that are merely slow to wind down don't trip.
+    """
+    from tpu_sandbox.runtime import kvstore
+
+    threads_before = set(threading.enumerate())
+    servers_before = set(kvstore.live_servers())
+    yield
+    me = threading.current_thread()
+
+    def stragglers():
+        return [t for t in threading.enumerate()
+                if t not in threads_before and t is not me
+                and not t.daemon and t.is_alive()]
+
+    deadline = time.monotonic() + 2.0
+    leaked_threads = stragglers()
+    while leaked_threads and time.monotonic() < deadline:
+        for t in leaked_threads:
+            t.join(timeout=0.2)
+        leaked_threads = stragglers()
+
+    leaked_servers = [s for s in kvstore.live_servers()
+                      if s not in servers_before]
+    problems = []
+    if leaked_servers:
+        ports = [s.port for s in leaked_servers]
+        for s in leaked_servers:  # free the ports for the rest of the run
+            s.stop()
+        problems.append(
+            f"{len(ports)} KVServer(s) left running on port(s) {ports}"
+        )
+    if leaked_threads:
+        names = ", ".join(repr(t.name) for t in leaked_threads)
+        problems.append(f"non-daemon thread(s) still alive: {names}")
+    if problems:
+        pytest.fail("resource leak: " + "; ".join(problems), pytrace=False)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,131 @@
+"""LeaseElection unit tests: acquire, renew, expire, steal, depose, resign,
+and the orphaned-claim grace window — all in-process against one KVServer,
+no subprocesses, short TTLs. The multi-process behavior (leader death
+mid-generation, failover continuing the job) lives in the slow
+test_multihost_elastic_integration module; this file pins the protocol."""
+
+import time
+
+import pytest
+
+from tpu_sandbox.runtime.election import LeaderInfo, LeaseElection
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+# KV round trips are sub-millisecond (TCP_NODELAY), but a TTL still has to
+# dwarf a handful of them plus scheduler jitter under a loaded test box.
+TTL = 0.5
+
+
+@pytest.fixture()
+def kv():
+    with KVServer() as srv:
+        clients = []
+
+        def make():
+            c = KVClient(port=srv.port)
+            clients.append(c)
+            return c
+
+        yield make
+        for c in clients:
+            c.close()
+
+
+def _member(kv, mid, **kw):
+    kw.setdefault("ttl", TTL)
+    return LeaseElection(kv(), mid, **kw)
+
+
+def test_first_candidate_acquires_term_1(kv):
+    a = _member(kv, "a")
+    assert a.step() is True
+    assert a.is_leader and a.term == 1
+    assert a.observe() == LeaderInfo(1, "a")
+
+
+def test_follower_observes_without_stealing(kv):
+    a, b = _member(kv, "a"), _member(kv, "b")
+    assert a.step() is True
+    assert b.step() is False           # sees a's live lease, follows
+    assert b.term == 1 and not b.is_leader
+    assert b.observe() == LeaderInfo(1, "a")
+
+
+def test_renewal_keeps_lease_past_ttl(kv):
+    a, b = _member(kv, "a"), _member(kv, "b")
+    assert a.step() is True
+    deadline = time.monotonic() + 3 * TTL
+    while time.monotonic() < deadline:
+        assert a.step() is True        # renew well inside the TTL
+        assert b.step() is False       # never a vacancy to elect into
+        time.sleep(TTL / 3)
+    assert a.term == 1                 # same term throughout: renewed, not re-won
+
+
+def test_expired_lease_is_stolen_at_higher_term(kv):
+    a, b = _member(kv, "a"), _member(kv, "b")
+    assert a.step() is True
+    time.sleep(TTL * 2)                # a stops renewing: lease evaporates
+    assert b.step() is True
+    assert b.term == 2                 # new term, not a resurrection of 1
+    assert b.observe() == LeaderInfo(2, "b")
+
+
+def test_stale_leader_abdicates_after_takeover(kv):
+    a, b = _member(kv, "a"), _member(kv, "b")
+    assert a.step() is True
+    time.sleep(TTL * 2)
+    assert b.step() is True            # term 2 established
+    # a comes back (partition healed): sees the advanced term, steps down
+    assert a.step() is False
+    assert not a.is_leader and a.term == 2
+    assert b.step() is True            # b unaffected
+
+
+def test_non_candidate_never_elects_but_still_follows(kv):
+    b = _member(kv, "b")
+    assert b.step(candidate=False) is False
+    assert b.observe() is None         # vacancy left untouched
+    a = _member(kv, "a")
+    assert a.step() is True
+    assert b.step(candidate=False) is False
+    assert b.term == 1                 # does follow the winner it observes
+
+
+def test_resign_hands_off_without_waiting_out_ttl(kv):
+    a, b = _member(kv, "a"), _member(kv, "b")
+    assert a.step() is True
+    a.resign()
+    assert b.step() is True            # immediate: no TTL wait needed
+    assert b.term == 2
+
+
+def test_orphaned_claim_blocks_only_for_grace(kv):
+    """A claimant that dies between claim and establish leaves a persistent
+    claim key. Candidates wait out claim_grace on that term, then skip it —
+    bounded stall, never a deadlock."""
+    store = kv()
+    store.add("leader/claim/1", 1)     # orphan: claimed, never established
+    b = LeaseElection(kv(), "b", ttl=TTL, claim_grace=0.4)
+    t0 = time.monotonic()
+    assert b.step() is False           # inside the orphan's grace window
+    while not b.step():
+        assert time.monotonic() - t0 < 5.0, "grace window never expired"
+        time.sleep(0.05)
+    waited = time.monotonic() - t0
+    assert waited >= 0.3               # did actually honor the grace
+    assert b.term == 2                 # skipped the bricked term entirely
+
+
+def test_claim_race_has_exactly_one_winner(kv):
+    """All members run the same vacancy election; add() arbitration must
+    produce exactly one leader no matter the interleaving."""
+    # generous ttl: five sequential steps cost ~15 round-trips and the lease
+    # must not lapse mid-pass, or a "second winner" is just a legal steal
+    members = [_member(kv, str(i), ttl=5.0) for i in range(5)]
+    results = [m.step() for m in members]
+    assert sum(results) == 1
+    leader = members[results.index(True)]
+    assert all(m.term == leader.term for m in members)
+    # and every later step agrees
+    assert [m.step() for m in members] == results

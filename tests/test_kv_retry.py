@@ -87,3 +87,67 @@ def test_keys_and_delete_prefix():
         with pytest.raises(ValueError):
             kv.delete_prefix("")  # whole-store wipe must not be a typo away
         kv.close()
+
+# -- read-retry (host-agent control plane) ---------------------------------
+#
+# Reads (get/try_get/keys) are idempotent, so the client retries them with
+# jittered backoff and a fresh connection — an agent polling `elastic/
+# generation` across a KV hiccup should see a blip, not a crash. Writes
+# stay single-shot: a retried add() could double-claim a charge budget.
+
+
+def test_read_survives_server_restart_on_same_port():
+    port = int(find_free_port())
+    first = KVServer(port=port)
+    kv = KVClient(port=port)
+    kv.set("elastic/generation", b"3")
+    first.stop()  # connection now dead; next read must redial, not raise
+
+    second = {}
+
+    def restart():
+        time.sleep(0.3)
+        second["srv"] = KVServer(port=port)
+        c = KVClient(port=port)
+        c.set("elastic/generation", b"4")  # restarted store, new contents
+        c.close()
+
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        assert kv.try_get("elastic/generation") == b"4"
+        assert kv.keys("elastic/") == ["elastic/generation"]
+    finally:
+        t.join()
+        second["srv"].stop()
+        kv.close()
+
+
+def test_read_retry_is_bounded_when_server_stays_dead():
+    server = KVServer()
+    kv = KVClient(port=server.port, connect_timeout=0.3)
+    kv.set("k", b"v")
+    server.stop()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="kv"):
+        for _ in range(3):  # first read can still drain the closing socket
+            kv.try_get("k")
+            time.sleep(0.05)
+    # five attempts x short backoff x bounded reconnect — seconds, not forever
+    assert time.monotonic() - t0 < 30.0
+    kv.close()
+
+
+def test_writes_do_not_retry_across_server_death():
+    """add() is the election/charge primitive — replaying it after a
+    reconnect could hand two agents the same claim. It must fail loud on
+    the very path where reads quietly recover."""
+    server = KVServer()
+    kv = KVClient(port=server.port, connect_timeout=0.3)
+    kv.set("budget/claim/1", b"0")
+    server.stop()
+    with pytest.raises(RuntimeError):
+        for _ in range(3):
+            kv.add("budget/claim/1", 1)
+            time.sleep(0.05)
+    kv.close()

@@ -290,6 +290,50 @@ def test_host_checkpoint_corrupt_falls_back(tmp_path, capsys):
     assert "quarantined" in capsys.readouterr().out
 
 
+def test_host_checkpoint_writes_sha256_sidecar(tmp_path):
+    from tpu_sandbox.train.checkpoint import verify_npz_sidecar
+
+    hc = HostCheckpoint(tmp_path, keep=2)
+    for step in (1, 2, 3):
+        hc.save(_tree(step), step, epoch=0, offset=step)
+    # every kept step has a matching sidecar; pruned steps lost theirs
+    assert sorted(p.name for p in tmp_path.glob("*.sha256")) == [
+        "step-00000002.npz.sha256", "step-00000003.npz.sha256",
+    ]
+    for step in (2, 3):
+        assert verify_npz_sidecar(tmp_path / f"step-{step:08d}.npz") is None
+
+
+def test_host_checkpoint_sidecar_catches_valid_but_wrong_npz(tmp_path, capsys):
+    """The nasty case 'does the zipfile parse' cannot see: the newest file
+    is replaced by a perfectly LOADABLE npz with wrong content. The hash
+    check must quarantine it (sidecar moved along) and fall back."""
+    hc = HostCheckpoint(tmp_path)
+    hc.save(_tree(1), 1, epoch=0, offset=1)
+    hc.save(_tree(2), 2, epoch=0, offset=2)
+    # forge step 2: valid npz, right schema, wrong params
+    forged = _tree(99)
+    hc_forge = HostCheckpoint(tmp_path / "forge")
+    src = hc_forge.save(forged, 2, epoch=0, offset=2)
+    (tmp_path / "step-00000002.npz").write_bytes(src.read_bytes())
+    state, meta = hc.restore(_tree(0))
+    assert meta["step"] == 1                       # fell back past the forgery
+    np.testing.assert_array_equal(state["w"], _tree(1)["w"])
+    assert "sha256 mismatch" in capsys.readouterr().out
+    names = sorted(p.name for p in tmp_path.glob("*.corrupt"))
+    assert names == ["step-00000002.npz.corrupt",
+                     "step-00000002.npz.sha256.corrupt"]
+
+
+def test_host_checkpoint_legacy_file_without_sidecar_restores(tmp_path):
+    hc = HostCheckpoint(tmp_path)
+    hc.save(_tree(5), 5, epoch=0, offset=5)
+    (tmp_path / "step-00000005.npz.sha256").unlink()  # pre-integrity file
+    state, meta = hc.restore(_tree(0))
+    assert meta["step"] == 5
+    np.testing.assert_array_equal(state["w"], _tree(5)["w"])
+
+
 def test_host_checkpoint_empty_and_shape_mismatch(tmp_path):
     hc = HostCheckpoint(tmp_path)
     assert hc.restore(_tree(0)) is None  # fresh start

@@ -275,6 +275,39 @@ def test_verify_ckpt_cli(tmp_path, capsys):
     assert main([str(tmp_path / "missing")]) == 2
 
 
+def test_verify_ckpt_cli_audits_host_npz(tmp_path, capsys):
+    """HostCheckpoint npz files in the directory are audited too: sidecar
+    hash first, then an actual load; no sidecar is a note, not a failure."""
+    import sys
+
+    from tpu_sandbox.train.checkpoint import HostCheckpoint
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from tools.verify_ckpt import main
+
+    hc = HostCheckpoint(tmp_path / "ck", keep=3)
+    hc.save({"w": np.arange(6.0)}, 4, epoch=0, offset=4)
+    hc.save({"w": np.arange(6.0)}, 8, epoch=0, offset=8)
+    assert main([str(tmp_path / "ck")]) == 0
+    assert "sha256 verified" in capsys.readouterr().out
+
+    # legacy file (no sidecar): noted, still exit 0
+    (tmp_path / "ck" / "step-00000004.npz.sha256").unlink()
+    assert main([str(tmp_path / "ck")]) == 0
+    assert "no sidecar (unverified)" in capsys.readouterr().out
+
+    # loadable forgery: only the hash can tell -> exit 1
+    np.savez(tmp_path / "ck" / "step-00000008.npz",
+             **{"__meta__": np.array("{}")})
+    assert main([str(tmp_path / "ck")]) == 1
+    assert "sha256 mismatch" in capsys.readouterr().out
+
+    # truncated legacy file: the load check catches it -> exit 1
+    (tmp_path / "ck" / "step-00000004.npz").write_bytes(b"debris")
+    assert main([str(tmp_path / "ck"), "-q"]) == 1
+    assert "does not load" in capsys.readouterr().out
+
+
 def test_compressed_shards_round_trip(tmp_path):
     """compress=True writes zlib-deflated npz shards: restore is bitwise
     (np.load inflates transparently; checksums are over the bytes on

@@ -15,8 +15,14 @@ usage errors. ``--strict`` also fails on torn steps, for post-run checks
 where the job is known to have finished cleanly.
 
 HostCheckpoint npz files (``step-*.npz``) sitting in the same directory
-are checked for basic loadability with ``--host-npz`` (they carry no
-checksums — presence of a readable zip is the best available signal).
+are audited automatically: re-hashed against their ``.sha256`` sidecar
+when one exists, then parse-checked with ``np.load``. Pre-integrity
+files without a sidecar get the parse check only and are noted, not
+failed — a missing sidecar is a provenance gap, not corruption.
+
+    npz  step-00000016.npz  ok        sha256 verified, 0.1 MiB
+    npz  step-00000008.npz  ok        no sidecar (unverified), loads
+    npz  step-00000012.npz  CORRUPT   sha256 mismatch — ...
 
 Runs from a repo checkout without installation:
     python tools/verify_ckpt.py /path/to/ckpt-dir
@@ -26,8 +32,9 @@ from __future__ import annotations
 
 import argparse
 import sys
-import zipfile
 from pathlib import Path
+
+import numpy as np
 
 
 def _ensure_import_path() -> None:
@@ -42,7 +49,11 @@ def _dir_bytes(step_dir: Path) -> int:
 
 def main(argv=None) -> int:
     _ensure_import_path()
-    from tpu_sandbox.train.checkpoint import _parse_step_dir, verify_step_dir
+    from tpu_sandbox.train.checkpoint import (
+        _parse_step_dir,
+        verify_npz_sidecar,
+        verify_step_dir,
+    )
 
     ap = argparse.ArgumentParser(
         description="re-hash sharded checkpoint steps against their "
@@ -53,8 +64,8 @@ def main(argv=None) -> int:
                     help="fail on torn (unsealed) steps too, not just "
                          "corrupt ones")
     ap.add_argument("--host-npz", action="store_true",
-                    help="also check HostCheckpoint step-*.npz files for "
-                         "loadability (no checksums exist for those)")
+                    help="(kept for compatibility; host npz files are now "
+                         "always audited when present)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="only print problems and the summary line")
     args = ap.parse_args(argv)
@@ -89,28 +100,43 @@ def main(argv=None) -> int:
             print(f"step {step:08d}  CORRUPT   "
                   + "; ".join(p.split(": ", 1)[-1] for p in problems))
 
-    npz_bad = 0
-    if args.host_npz:
-        for f in sorted(root.glob("step-*.npz")):
-            tail = f.stem.split("-", 1)[1]
-            if not tail.isdigit():
-                continue
-            ok = zipfile.is_zipfile(f)
-            if not ok:
-                npz_bad += 1
-                print(f"npz  {f.name}  UNREADABLE (not a zip archive)")
-            elif not args.quiet:
-                print(f"npz  {f.name}  readable")
+    npz_total = npz_bad = npz_unverified = 0
+    for f in sorted(root.glob("step-*.npz")):
+        tail = f.stem.split("-", 1)[1]
+        if not tail.isdigit():
+            continue
+        npz_total += 1
+        problem = verify_npz_sidecar(f)
+        if problem is not None:
+            npz_bad += 1
+            print(f"npz  {f.name}  CORRUPT   {problem}")
+            continue
+        has_sidecar = Path(str(f) + ".sha256").exists()
+        try:
+            with np.load(f, allow_pickle=False) as z:
+                z["__meta__"]
+        except Exception as e:
+            npz_bad += 1
+            print(f"npz  {f.name}  CORRUPT   does not load ({e!r})")
+            continue
+        if not has_sidecar:
+            npz_unverified += 1
+            print(f"npz  {f.name}  ok        no sidecar (unverified), loads")
+        elif not args.quiet:
+            mib = f.stat().st_size / (1 << 20)
+            print(f"npz  {f.name}  ok        sha256 verified, {mib:.1f} MiB")
 
     quarantine = root.parent / (root.name + ".quarantine")
     quarantined = (
         len([p for p in quarantine.iterdir() if p.is_dir()])
         if quarantine.is_dir() else 0
     )
+    quarantined += len(list(root.glob("step-*.npz.corrupt")))
 
     print(f"{len(step_dirs)} step(s): {sealed} sealed, {torn} torn, "
           f"{corrupt} corrupt"
-          + (f"; {npz_bad} unreadable npz" if args.host_npz else "")
+          + (f"; {npz_total} host npz ({npz_bad} corrupt, "
+             f"{npz_unverified} unverified)" if npz_total else "")
           + (f"; {quarantined} previously quarantined" if quarantined else ""))
     if corrupt or npz_bad:
         return 1

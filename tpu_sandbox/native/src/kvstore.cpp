@@ -28,6 +28,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -265,6 +266,11 @@ Server* kv_server_start(int port) {
     for (;;) {
       int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
       if (cfd < 0) return;  // listen socket closed -> shutdown
+      // Request/response over multi-write() framing: without TCP_NODELAY,
+      // Nagle + delayed ACK turns every round trip into ~40-90ms, which an
+      // election or heartbeat loop pays per KV op.
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(srv->conns_mu);
       if (srv->stopping) {
         ::close(cfd);
@@ -303,6 +309,8 @@ void kv_server_stop(Server* srv) {
 int kv_connect(const char* host, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);

@@ -10,16 +10,27 @@ from tpu_sandbox.runtime.bootstrap import (  # noqa: F401
     topology,
     topology_summary,
 )
+from tpu_sandbox.runtime.election import (  # noqa: F401
+    LeaderInfo,
+    LeaseElection,
+)
 from tpu_sandbox.runtime.faults import (  # noqa: F401
     Fault,
     FaultInjector,
     FaultPlan,
+)
+from tpu_sandbox.runtime.host_agent import (  # noqa: F401
+    AgentConfig,
+    AgentLauncher,
+    HostAgent,
+    ranks_for_agent,
 )
 from tpu_sandbox.runtime.mesh import make_mesh, submesh  # noqa: F401
 from tpu_sandbox.runtime.supervisor import (  # noqa: F401
     PREEMPTED_EXIT_CODE,
     ElasticResult,
     GenerationReport,
+    RankGroup,
     RestartBudgetExceeded,
     Supervisor,
 )

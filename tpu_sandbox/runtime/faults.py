@@ -39,6 +39,16 @@ Actions:
                     torn-step case the two-phase protocol exists for. Fired
                     via ``maybe_fire_commit`` from the checkpoint layer's
                     commit hook, never at a step boundary.
+``kill_agent``      SIGKILL this rank's *host agent* (host_agent.py) — the
+                    host-death / leader-death case of the cross-host design.
+                    The rank posts a command on the agent's KV mailbox
+                    (``agent/cmd/<id>``); the agent executes it, and its
+                    PR_SET_PDEATHSIG children die with it, exactly like a
+                    machine vanishing.
+``partition_host``  the agent stops talking to the KV store for ``target``
+                    seconds while its ranks keep running — the network
+                    partition only agent-level heartbeat monitoring can see.
+                    Routed through the same agent mailbox.
 """
 
 from __future__ import annotations
@@ -53,11 +63,31 @@ from typing import Callable, Mapping, MutableMapping
 ENV_PLAN = "TPU_SANDBOX_FAULT_PLAN"
 
 ACTIONS = ("kill", "sigterm", "hang_heartbeat", "corrupt_ckpt",
-           "corrupt_shard", "kill_during_commit")
+           "corrupt_shard", "kill_during_commit", "kill_agent",
+           "partition_host")
 
 #: Actions that fire inside the checkpoint commit window (via
 #: ``maybe_fire_commit``) rather than at an optimizer-step boundary.
 COMMIT_ACTIONS = ("kill_during_commit",)
+
+#: Actions executed by this rank's HOST AGENT, not by the rank itself:
+#: the rank claims the fault at its step boundary, then posts a command on
+#: the agent's KV mailbox. Requires agent-mode elastic runs (a KV store and
+#: TPU_SANDBOX_AGENT_ID in the rank's env).
+AGENT_ACTIONS = ("kill_agent", "partition_host")
+
+ENV_AGENT_ID = "TPU_SANDBOX_AGENT_ID"
+
+
+def agent_cmd_key(agent_id: int | str) -> str:
+    """The agent's fault-command mailbox (single-slot: agents consume it
+    with delete-after-read)."""
+    return f"agent/cmd/{agent_id}"
+
+
+def agent_id_from_env(environ: Mapping[str, str] | None = None) -> int | None:
+    raw = (environ or os.environ).get(ENV_AGENT_ID, "")
+    return int(raw) if raw else None
 
 
 @dataclass(frozen=True)
@@ -76,6 +106,14 @@ class Fault:
             raise ValueError(
                 f"{self.action} needs target=<checkpoint dir>"
             )
+        if self.action == "partition_host" and self.target is not None:
+            try:
+                float(self.target)
+            except ValueError:
+                raise ValueError(
+                    "partition_host target must be a duration in seconds, "
+                    f"got {self.target!r}"
+                ) from None
 
 
 class FaultPlan:
@@ -136,11 +174,18 @@ class FaultInjector:
         kv=None,
         *,
         on_hang_heartbeat: Callable[[], None] | None = None,
+        agent_id: int | None = None,
     ):
         self.plan = plan
         self.rank = rank
         self.kv = kv
         self.on_hang_heartbeat = on_hang_heartbeat
+        # which host agent owns this rank (agent-mode elastic runs set
+        # TPU_SANDBOX_AGENT_ID in the worker env); agent-targeted faults
+        # are posted to that agent's mailbox
+        self.agent_id = agent_id if agent_id is not None else (
+            agent_id_from_env()
+        )
         self._claimed_local: set[int] = set()
 
     def _claim(self, index: int) -> bool:
@@ -201,6 +246,16 @@ class FaultInjector:
             corrupt_latest_step(f.target)
         elif f.action == "corrupt_shard":
             corrupt_latest_shard(f.target, rank=self.rank)
+        elif f.action in AGENT_ACTIONS:
+            if self.kv is None or self.agent_id is None:
+                raise RuntimeError(
+                    f"{f.action} needs a KV store and {ENV_AGENT_ID} in the "
+                    "worker env — agent-mode elastic runs only (--agents N)"
+                )
+            self.kv.set(
+                agent_cmd_key(self.agent_id),
+                json.dumps({"action": f.action, "arg": f.target}),
+            )
 
 
 # -- checkpoint corruption (also used directly by tests) -------------------

@@ -11,8 +11,10 @@ the multi-process CPU test strategy.
 from __future__ import annotations
 
 import ctypes
+import random
 import threading
 import time
+import weakref
 
 
 def _lib() -> ctypes.CDLL:
@@ -43,6 +45,18 @@ def _lib() -> ctypes.CDLL:
     return lib
 
 
+# Every live KVServer registers here so the test suite can detect servers a
+# test forgot to stop (the C++ accept/worker threads are invisible to Python's
+# threading.enumerate, so a leak check needs this explicit registry). WeakSet:
+# the registry must not keep abandoned servers alive on its own.
+_live_servers: "weakref.WeakSet[KVServer]" = weakref.WeakSet()
+
+
+def live_servers() -> list["KVServer"]:
+    """Servers constructed but not yet stop()ed (GC'd ones drop out)."""
+    return [s for s in _live_servers if s._handle]
+
+
 class KVServer:
     """In-process store server (rank 0 runs one). port=0 -> OS-assigned."""
 
@@ -52,6 +66,7 @@ class KVServer:
         if not self._handle:
             raise RuntimeError(f"kv_server_start failed on port {port}")
         self.port = self._lib.kv_server_port(self._handle)
+        _live_servers.add(self)
 
     def stop(self) -> None:
         if self._handle:
@@ -99,20 +114,64 @@ class KVClient:
         # (e.g. a Heartbeat thread sharing the owner's client) must serialize
         self._mu = threading.Lock()
 
+    # Idempotent reads may be transparently retried on a fresh connection
+    # after a transient socket error: re-running them cannot change store
+    # state. Writes (set/add/delete/...) stay single-shot and fail loud —
+    # a retried add() would double-count and a retried set() could resurrect
+    # a key someone deleted in between.
+    _RETRYABLE_OPS = frozenset({"G", "T", "L"})
+    _READ_RETRIES = 5
+    _RETRY_BASE_DELAY = 0.05
+
+    def _reconnect(self) -> None:
+        """Drop the (presumed broken) connection and dial again, bounded by
+        the client's original connect_timeout."""
+        if self._fd >= 0:
+            self._lib.kv_close(self._fd)
+            self._fd = -1
+        deadline = time.monotonic() + max(self.connect_timeout, 1.0)
+        delay = 0.02
+        while True:
+            self._fd = self._lib.kv_connect(self.host.encode(), self.port)
+            if self._fd >= 0:
+                return
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"kv reconnect {self.host}:{self.port} failed"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+
     def _request(
         self, op: str, key: str, val: bytes = b"", cap: int = 1 << 20
     ) -> bytes | None:
         out = ctypes.create_string_buffer(cap)
+        attempts = self._READ_RETRIES if op in self._RETRYABLE_OPS else 1
         with self._mu:
-            n = self._lib.kv_request(
-                self._fd, op.encode(), key.encode(), len(key.encode()),
-                val, len(val), out, cap,
-            )
-        if n == -2:
-            return None  # try-get: key missing
-        if n < 0:
-            raise RuntimeError(f"kv {op} {key!r} failed")
-        return out.raw[:n]
+            for attempt in range(attempts):
+                n = self._lib.kv_request(
+                    self._fd, op.encode(), key.encode(), len(key.encode()),
+                    val, len(val), out, cap,
+                )
+                if n == -2:
+                    return None  # try-get: key missing
+                if n >= 0:
+                    return out.raw[:n]
+                # n < 0: request failed (dead socket, server restarting).
+                # For idempotent reads, back off with jitter and try again
+                # on a fresh connection — a leader failover must not kill
+                # every agent mid-poll over one dropped packet.
+                if attempt + 1 >= attempts:
+                    break
+                time.sleep(
+                    self._RETRY_BASE_DELAY * (2**attempt)
+                    * (0.5 + random.random())
+                )
+                try:
+                    self._reconnect()
+                except ConnectionError:
+                    break  # nothing is listening; fail below
+        raise RuntimeError(f"kv {op} {key!r} failed")
 
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):

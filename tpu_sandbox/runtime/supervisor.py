@@ -50,6 +50,96 @@ ENV_GENERATION = "TPU_SANDBOX_GENERATION"
 PREEMPT_KEY = "preempt/requested"
 
 
+def _pdeathsig_preexec():
+    """preexec_fn that makes the child die (SIGKILL) when its parent does —
+    the "host death kills everything on the host" contract a per-host agent
+    needs (runtime/host_agent.py): SIGKILLing the agent must not orphan its
+    rank processes into zombie trainers that keep heartbeating into the next
+    generation. Linux PR_SET_PDEATHSIG; silently a no-op elsewhere."""
+    try:
+        import ctypes
+        import ctypes.util
+
+        libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                           use_errno=True)
+        libc.prctl(1, signal.SIGKILL, 0, 0, 0)  # 1 == PR_SET_PDEATHSIG
+    except Exception:
+        pass
+
+
+class RankGroup:
+    """Spawn/poll/stop a set of local rank processes.
+
+    The proc-management core shared by the single-host :class:`Supervisor`
+    and the per-host ``HostAgent``: owns the Popen handles, caches exit
+    codes across polls, and implements the SIGTERM→wait→SIGKILL teardown
+    escalation (SIGTERM gives the trainer's preemption handler a chance to
+    save; SIGKILL unwedges ranks stuck in a native collective).
+    """
+
+    def __init__(
+        self,
+        *,
+        term_timeout: float = 30.0,
+        kill_on_parent_death: bool = False,
+    ):
+        self.term_timeout = term_timeout
+        self._preexec = _pdeathsig_preexec if kill_on_parent_death else None
+        self._procs: list[subprocess.Popen] = []
+        self._codes: list[int | None] = []
+
+    def spawn(
+        self,
+        cmds: Sequence[Sequence[str]],
+        env: Mapping[str, str] | None = None,
+    ) -> None:
+        if self._procs and any(c is None for c in self.poll()):
+            raise RuntimeError("RankGroup.spawn while previous group runs")
+        self._procs = [
+            subprocess.Popen(list(cmd),
+                             env=None if env is None else dict(env),
+                             preexec_fn=self._preexec)
+            for cmd in cmds
+        ]
+        self._codes = [None] * len(self._procs)
+
+    def __len__(self) -> int:
+        return len(self._procs)
+
+    def poll(self) -> list[int | None]:
+        """Exit codes so far (None = still running); cached once observed."""
+        for i, p in enumerate(self._procs):
+            if self._codes[i] is None:
+                self._codes[i] = p.poll()
+        return list(self._codes)
+
+    @property
+    def running(self) -> bool:
+        return any(c is None for c in self.poll())
+
+    def terminate_all(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+
+    def teardown(self) -> list[int | None]:
+        """SIGTERM everyone, wait up to ``term_timeout``, SIGKILL stragglers;
+        returns the final exit codes."""
+        self.terminate_all()
+        deadline = time.monotonic() + self.term_timeout
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        return self.poll()
+
+
 class RestartBudgetExceeded(RuntimeError):
     """The job kept dying after ``max_restarts`` charged restarts (or blew
     through ``max_preemptions``); carries the full generation history."""
@@ -140,7 +230,7 @@ class Supervisor:
         self._owns_server = kv_server is None
         self.verbose = verbose
         self._external_preempt = False
-        self._procs: list[subprocess.Popen] = []
+        self._group = RankGroup(term_timeout=term_timeout)
 
     # -- logging ----------------------------------------------------------
 
@@ -181,26 +271,12 @@ class Supervisor:
     # -- teardown ----------------------------------------------------------
 
     def _teardown(self, codes: list[int | None]) -> None:
-        """Stop every still-running worker: SIGTERM first (gives the
-        trainer's preemption handler a chance to save), SIGKILL stragglers
-        wedged in a native collective."""
-        for p in self._procs:
-            if p.poll() is None:
-                try:
-                    p.terminate()
-                except OSError:
-                    pass
-        deadline = time.monotonic() + self.term_timeout
-        for p in self._procs:
-            if p.poll() is None:
-                try:
-                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    p.wait()
-        for i, p in enumerate(self._procs):
+        """Stop every still-running worker (SIGTERM→wait→SIGKILL via the
+        :class:`RankGroup`) and fill in the final exit codes."""
+        final = self._group.teardown()
+        for i, c in enumerate(final):
             if codes[i] is None:
-                codes[i] = p.poll()
+                codes[i] = c
 
     # -- one generation ----------------------------------------------------
 
@@ -217,7 +293,7 @@ class Supervisor:
         env[ENV_KV_PORT] = str(kv_port)
         env[ENV_GENERATION] = str(gen)
         start = time.monotonic()
-        self._procs = [subprocess.Popen(cmd, env=env) for cmd in cmds]
+        self._group.spawn(cmds, env)
         watchdog = Watchdog(
             kv, self.world_size,
             timeout=self.heartbeat_timeout, grace=self.grace,
@@ -225,9 +301,7 @@ class Supervisor:
         codes: list[int | None] = [None] * self.world_size
         try:
             while any(c is None for c in codes):
-                for i, p in enumerate(self._procs):
-                    if codes[i] is None:
-                        codes[i] = p.poll()
+                codes = self._group.poll()
                 culprits = [
                     r for r, c in enumerate(codes) if c not in (None, 0)
                 ]
@@ -255,7 +329,7 @@ class Supervisor:
         finally:
             # belt and braces: never leak workers past a generation, even
             # when the monitor loop itself raises (e.g. KeyboardInterrupt)
-            if any(p.poll() is None for p in self._procs):
+            if self._group.running:
                 self._teardown(codes)
         return GenerationReport(
             gen, "ok", codes, [], time.monotonic() - start
@@ -268,12 +342,7 @@ class Supervisor:
         preemption). Returns the previous handler, restored by run()."""
         def fwd(signum, frame):
             self._external_preempt = True
-            for p in self._procs:
-                if p.poll() is None:
-                    try:
-                        p.terminate()
-                    except OSError:
-                        pass
+            self._group.terminate_all()
         try:
             return signal.signal(signal.SIGTERM, fwd)
         except ValueError:

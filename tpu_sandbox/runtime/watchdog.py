@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Callable
 
 from tpu_sandbox.runtime.kvstore import KVClient
 
@@ -54,10 +55,20 @@ class Heartbeat:
         hb.stop()
     """
 
-    def __init__(self, client: KVClient, rank: int, interval: float = 1.0):
+    def __init__(
+        self,
+        client: KVClient,
+        rank: int,
+        interval: float = 1.0,
+        *,
+        key: str | None = None,
+    ):
         self._owner = client
         self.rank = rank
         self.interval = interval
+        # default key is the per-rank health-plane slot; host agents pass
+        # their own (e.g. "agent_hb/<id>") to publish on a separate plane
+        self.key = _hb_key(rank) if key is None else key
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._clone: KVClient | None = None
@@ -73,7 +84,7 @@ class Heartbeat:
         return self._clone
 
     def beat_once(self) -> None:
-        self.client.set(_hb_key(self.rank), repr(time.time()).encode())
+        self.client.set(self.key, repr(time.time()).encode())
 
     def start(self) -> "Heartbeat":
         if self._thread is not None:
@@ -107,7 +118,7 @@ class Heartbeat:
             self._thread = None
         if deregister:
             try:
-                self.client.delete(_hb_key(self.rank))
+                self.client.delete(self.key)
             except Exception:
                 pass
         if self._clone is not None:
@@ -147,10 +158,14 @@ class Watchdog:
         *,
         timeout: float = 10.0,
         grace: float | None = None,
+        key_fn: "Callable[[int], str]" = _hb_key,
     ):
         self.client = client
         self.world_size = world_size
         self.timeout = timeout
+        # key_fn maps member index -> heartbeat key; the default watches the
+        # per-rank plane, host agents watch each other via "agent_hb/<id>"
+        self.key_fn = key_fn
         # ranks that never wrote at all get `grace` seconds from watchdog
         # construction before they count as dead (startup skew)
         self.grace = timeout if grace is None else grace
@@ -162,7 +177,7 @@ class Watchdog:
         now = time.time()
         report = []
         for rank in range(self.world_size):
-            raw = self.client.try_get(_hb_key(rank))
+            raw = self.client.try_get(self.key_fn(rank))
             if raw is None:
                 alive = (now - self._born) < self.grace
                 report.append(RankHealth(rank, alive, None))

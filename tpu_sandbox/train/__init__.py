@@ -2,6 +2,7 @@ from tpu_sandbox.train.state import TrainState  # noqa: F401
 from tpu_sandbox.train.trainer import (  # noqa: F401
     PREEMPTED_EXIT_CODE,
     AbortOnAnomaly,
+    ElasticEnv,
     Preempted,
     PreemptionHandler,
     ResumableReport,

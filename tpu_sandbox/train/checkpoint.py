@@ -267,10 +267,14 @@ class HostCheckpoint:
     with an atomic rename, and every rank restores by reading that file —
     no cross-process coordination anywhere on the save/restore path.
 
-    Restore validates the newest file by actually loading it; a truncated
-    or scribbled file (a worker killed mid-write can't produce one —
-    that's the tmp+rename — but fault injection and disk trouble can) is
-    renamed to ``*.corrupt`` and the next older step is used.
+    Restore verifies content integrity BEFORE parsing: every save writes a
+    SHA-256 sidecar (``step-<n>.npz.sha256``) and restore re-hashes the
+    npz against it first — a scribbled-but-still-valid zipfile (bitrot,
+    fault injection, a partial copy with plausible contents) is caught
+    here, where "does the zip parse" cannot see it. Files failing either
+    check are renamed to ``*.corrupt`` (sidecar moved along with them —
+    they are evidence) and the next older step is used. Files without a
+    sidecar (pre-integrity checkpoints) still restore on load success.
     """
 
     def __init__(self, directory: str | os.PathLike, *, keep: int = 3):
@@ -279,6 +283,9 @@ class HostCheckpoint:
 
     def _path(self, step: int) -> Path:
         return self.directory / f"step-{int(step):08d}.npz"
+
+    def _sidecar(self, step: int) -> Path:
+        return self.directory / f"step-{int(step):08d}.npz.sha256"
 
     def steps(self) -> list[int]:
         if not self.directory.is_dir():
@@ -321,6 +328,19 @@ class HostCheckpoint:
         except BaseException:
             Path(tmp).unlink(missing_ok=True)
             raise
+        # Integrity sidecar, written AFTER the npz lands: a crash between
+        # the two renames leaves a checkpoint without a sidecar (restorable,
+        # just unverified — same posture as a pre-integrity file), never a
+        # sidecar pointing at bytes that don't exist yet.
+        digest = _sha256_file(self._path(step))
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".sha256.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{digest}  {self._path(step).name}\n")
+            os.replace(tmp, self._sidecar(step))
+        except BaseException:
+            Path(tmp).unlink(missing_ok=True)
+            raise
         self._prune()
         return self._path(step)
 
@@ -330,10 +350,14 @@ class HostCheckpoint:
                 self._path(s).unlink()
             except OSError:
                 pass
+            self._sidecar(s).unlink(missing_ok=True)
             sidecar = self.directory / f"data_state-{s}.json"
             sidecar.unlink(missing_ok=True)
 
     def _load(self, step: int, template):
+        problem = verify_npz_sidecar(self._path(step))
+        if problem is not None:
+            raise ValueError(problem)
         with np.load(self._path(step), allow_pickle=False) as z:
             meta = json.loads(str(z["__meta__"]))
             leaves, treedef = _flatten_with_paths(template)
@@ -372,7 +396,41 @@ class HostCheckpoint:
                     )
                 except OSError:
                     pass  # concurrent restorer won the rename race
+                else:
+                    side = self._sidecar(s)
+                    if side.exists():
+                        try:  # keep the evidence pair together
+                            os.replace(side, Path(str(side) + ".corrupt"))
+                        except OSError:
+                            pass
         return None
+
+
+def verify_npz_sidecar(path: Path | str) -> str | None:
+    """Re-hash ``path`` against its ``.sha256`` sidecar.
+
+    Returns a human-readable problem description on mismatch (or on an
+    unparseable sidecar), ``None`` when the hash matches or no sidecar
+    exists — pre-integrity checkpoints stay restorable, their validity
+    judged only by whether they parse. Shared by HostCheckpoint restore
+    and ``tools/verify_ckpt.py``.
+    """
+    path = Path(path)
+    side = Path(str(path) + ".sha256")
+    if not side.exists():
+        return None
+    try:
+        recorded = side.read_text().split()[0]
+    except (OSError, IndexError):
+        return f"sidecar {side.name} unreadable or empty"
+    if len(recorded) != 64 or not all(c in "0123456789abcdef"
+                                      for c in recorded.lower()):
+        return f"sidecar {side.name} does not contain a sha256 digest"
+    actual = _sha256_file(path)
+    if actual != recorded:
+        return (f"{path.name}: sha256 mismatch — sidecar records "
+                f"{recorded[:12]}…, file hashes to {actual[:12]}…")
+    return None
 
 
 # -- ShardedCheckpoint: per-rank shards + manifest + two-phase commit ------

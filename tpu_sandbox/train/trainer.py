@@ -20,6 +20,7 @@ TPU-first differences:
 
 from __future__ import annotations
 
+import os
 import signal
 import time
 from dataclasses import dataclass
@@ -44,6 +45,32 @@ PREEMPTED_EXIT_CODE = 75
 #: (must match supervisor.PREEMPT_KEY; the supervisor clears it between
 #: generations).
 PREEMPT_KEY = "preempt/requested"
+
+#: Env vars a supervisor/host-agent sets on every rank it spawns. Mirrored
+#: from runtime/{supervisor,host_agent}.py (same no-process-layer-import
+#: rule as PREEMPTED_EXIT_CODE above).
+ENV_GENERATION = "TPU_SANDBOX_GENERATION"
+ENV_AGENT_ID = "TPU_SANDBOX_AGENT_ID"
+
+
+@dataclass(frozen=True)
+class ElasticEnv:
+    """The elastic identity a rank inherits from whoever spawned it:
+    which relaunch generation this process belongs to (stamps checkpoints
+    and KV claims) and which host agent owns it (``None`` outside the
+    cross-host agent topology — e.g. under the single-host Supervisor)."""
+
+    generation: str
+    agent_id: int | None
+
+    @classmethod
+    def from_env(cls, environ=None) -> "ElasticEnv":
+        env = os.environ if environ is None else environ
+        raw = env.get(ENV_AGENT_ID, "")
+        return cls(
+            generation=env.get(ENV_GENERATION, "1"),
+            agent_id=int(raw) if raw else None,
+        )
 
 
 def resize_on_device(images, image_size):

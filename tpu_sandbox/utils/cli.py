@@ -68,6 +68,38 @@ def add_grad_compress_cli(parser, error_feedback: bool = True) -> None:
                                  "the fp32-tracking convergence guarantee)")
 
 
+def add_elastic_cli(parser) -> None:
+    """Register the elastic/agent flag group (same single-site contract as
+    the checkpoint group: launchers, agents, and their respawned workers
+    all re-parse these exact flags)."""
+    parser.add_argument("--elastic", action="store_true",
+                        help="run the multiprocess topology under elastic "
+                             "supervision: crashed/preempted generations "
+                             "are relaunched and resume from the newest "
+                             "checkpoint with exact data order")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="with --elastic: charged restarts before "
+                             "giving up (preemptions are free)")
+    parser.add_argument("--agents", type=int, default=0, metavar="N",
+                        help="with --elastic: cross-host mode — N per-host "
+                             "agents (runtime/host_agent.py) coordinate "
+                             "generations over the KV store with leader "
+                             "election; 0 keeps the single-host supervisor. "
+                             "World size must divide by N")
+    parser.add_argument("--agent-id", type=int, default=None, metavar="ID",
+                        help="run exactly ONE host agent (0..N-1) of an "
+                             "--agents N job and exit with its verdict — "
+                             "for launching each host's agent yourself; "
+                             "needs --kv-port pointing at the job's store "
+                             "(or --leader to host it here)")
+    parser.add_argument("--leader", action="store_true",
+                        help="with --agent-id: host the coordination KV "
+                             "store inside this agent's process (start "
+                             "this agent first; peers connect via "
+                             "--kv-port). Note: the store currently binds "
+                             "loopback only — see ROADMAP")
+
+
 def _request_cpu_devices(n: int) -> None:
     """Ask for ``n`` virtual CPU devices, whatever this jax calls the knob.
 
