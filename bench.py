@@ -772,6 +772,117 @@ def bench_grad_compress_traffic(world: int = 8) -> dict:
     }
 
 
+def _measure_input_stall(n_batches: int = 30, load_ms: float = 10.0,
+                         step_ms: float = 10.0) -> dict:
+    """Measured wall-time of a sleep-modeled train loop with and without
+    the background prefetcher. The sleeps model a host-side batch assembly
+    (``load_ms``) and a device step (``step_ms``) of comparable cost — the
+    regime double-buffering exists for; the THREADING under test
+    (data/loader.PrefetchLoader's queue + producer) is the real one.
+    ``input_stall`` is time the consumer spends blocked in ``next()``."""
+    import time
+
+    from tpu_sandbox.data.loader import PrefetchLoader
+
+    class SlowLoader:
+        def __len__(self):
+            return n_batches
+
+        def __iter__(self):
+            for i in range(n_batches):
+                time.sleep(load_ms / 1e3)
+                yield i, i  # payload irrelevant: the stall is the metric
+
+    def consume(loader):
+        t0 = time.monotonic()
+        stall = 0.0
+        it = iter(loader)
+        while True:
+            t1 = time.monotonic()
+            try:
+                next(it)
+            except StopIteration:
+                break
+            stall += time.monotonic() - t1
+            time.sleep(step_ms / 1e3)  # the "train step"
+        return time.monotonic() - t0, stall
+
+    total_sync, stall_sync = consume(SlowLoader())
+    total_pre, stall_pre = consume(PrefetchLoader(SlowLoader()))
+    return {
+        "batches": n_batches,
+        "host_load_ms_per_batch": load_ms,
+        "step_ms": step_ms,
+        "total_sec_sync": round(total_sync, 4),
+        "total_sec_prefetch": round(total_pre, 4),
+        "input_stall_sec_sync": round(stall_sync, 4),
+        "input_stall_sec_prefetch": round(stall_pre, 4),
+        "stall_reduction_frac": round(
+            1.0 - stall_pre / stall_sync, 4) if stall_sync > 0 else None,
+        "source": "measured wall time; load/step modeled by sleeps, "
+                  "prefetch threading real (data/loader.PrefetchLoader)",
+    }
+
+
+def bench_overlap(world: int = 8) -> dict:
+    """The overlapped-step-pipeline receipts: (1) XLA schedule structure of
+    the bucketed gradient sync from a chipless multi-chip v5e AOT compile
+    (tools/hlo_schedule.py — how many per-bucket all-reduces are issued
+    before the last backward compute op, and the exposed-comm fraction);
+    (2) measured input-stall reduction from the double-buffered prefetch
+    loader. Chipless + host-threads: no accelerator probe."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "hlo_schedule.py")
+    # subprocess isolation: the AOT tool initializes libtpu and flips
+    # jax_platforms — neither survives nor belongs in this process
+    sched, err = None, None
+    try:
+        out = subprocess.run(
+            [_sys.executable, tool], capture_output=True, text=True,
+            timeout=600,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            sched = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            tail = (out.stderr or out.stdout).strip().splitlines()
+            err = tail[-1] if tail else f"exit {out.returncode}"
+    except Exception as e:  # missing libtpu, timeout, ...
+        err = f"{type(e).__name__}: {e}"
+
+    if sched is None:
+        # CPU SPMD fallback: still PROVES the bucket split happened (one
+        # collective per bucket in the HLO), but XLA:CPU lowers collectives
+        # synchronously and prints no schedule worth reading — say so.
+        from tpu_sandbox.utils.cli import ensure_devices
+
+        devices = ensure_devices(world, force_cpu=True)
+        _sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from hlo_schedule import build_overlapped_hlo, schedule_report
+
+        text = build_overlapped_hlo(devices, compiler_options={})
+        sched = schedule_report(text)
+        sched.pop("collectives", None)
+        sched["degraded"] = (
+            f"TPU AOT compile unavailable ({err}); CPU SPMD compile shows "
+            "the per-bucket collective split but carries no latency-hiding "
+            "schedule to audit"
+        )
+
+    sched.pop("collectives", None)
+    return {
+        "metric": "overlap",
+        "exposed_comm_fraction": sched.get("exposed_comm_fraction"),
+        "all_reduce_issues_before_last_bwd_compute": sched.get(
+            "all_reduce_issues_before_last_bwd_compute"),
+        "schedule": sched,
+        "input_stall": _measure_input_stall(),
+    }
+
+
 def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
                    max_batch: int = 512, plan: str = "auto") -> dict:
     """The reference's published experiment, measured: max batch at
@@ -1352,7 +1463,7 @@ def _chain_attn(fa, q, k, v, n):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
-                   choices=["grad_compress", "images_per_sec",
+                   choices=["grad_compress", "overlap", "images_per_sec",
                             "allreduce_bw", "pallas",
                             "capacity", "seq_scaling", "lm", "sweep",
                             "convergence"],
@@ -1382,6 +1493,10 @@ def main():
     if args.metric == "grad_compress":
         # chipless by design (CPU SPMD compile); no accelerator probe
         print(json.dumps(bench_grad_compress_traffic()))
+        return
+    if args.metric == "overlap":
+        # chipless AOT schedule + host-thread stall timing; no probe
+        print(json.dumps(bench_overlap()))
         return
     if args.metric != "images_per_sec":
         # probe-timeout 0 means "trust the environment" (same semantics as

@@ -29,7 +29,7 @@ Examples::
 
 import argparse
 
-from tpu_sandbox.utils.cli import add_grad_compress_cli
+from tpu_sandbox.utils.cli import add_grad_compress_cli, add_overlap_cli
 
 
 def make_batches(vocab: int, batch: int, seq_len: int, steps: int, seed: int):
@@ -130,19 +130,22 @@ def train(args):
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
 
     p = args.parallelism
-    if args.grad_compress != "none" and p != "dp":
-        # the compressed sync intercepts grads as they cross the batch
-        # axis; under tp/sp/pp/ep XLA owns the collective placement
+    if (args.grad_compress != "none" or args.overlap_grad_sync) and p != "dp":
+        # the compressed/bucketed sync intercepts grads as they cross the
+        # batch axis; under tp/sp/pp/ep XLA owns the collective placement
         raise SystemExit(
-            f"--grad-compress only composes with --parallelism dp "
-            f"(got {p!r}): other plans let XLA place the grad collectives"
+            f"--grad-compress/--overlap-grad-sync only compose with "
+            f"--parallelism dp (got {p!r}): other plans let XLA place the "
+            "grad collectives"
         )
     if p == "dp":
         mesh = make_mesh({"data": n}, devices=devices)
         model = TransformerLM(cfg, attention_fn=attention_fn)
         state = TrainState.create(model, rng, sample, tx)
         eng = PjitEngine(model, tx, mesh, task="lm",
-                         grad_compress=args.grad_compress)
+                         grad_compress=args.grad_compress,
+                         overlap_grad_sync=args.overlap_grad_sync,
+                         bucket_mb=args.bucket_mb)
     elif p == "tp":
         if args.dp < 1 or n % args.dp:
             raise SystemExit(f"--dp {args.dp} must be >= 1 and divide {n} devices")
@@ -293,6 +296,8 @@ def build_parser() -> argparse.ArgumentParser:
     # dp only; no --no-error-feedback here — PjitEngine's compressed sync
     # is stateless (no residual to carry), unlike DataParallel's
     add_grad_compress_cli(parser, error_feedback=False)
+    # dp only likewise; no --prefetch (synthetic in-memory stream)
+    add_overlap_cli(parser, prefetch=False)
     return parser
 
 

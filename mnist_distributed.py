@@ -23,6 +23,7 @@ from tpu_sandbox.utils.cli import (
     add_checkpoint_cli,
     add_elastic_cli,
     add_grad_compress_cli,
+    add_overlap_cli,
 )
 
 IMAGE_SHAPE = [3000, 3000]
@@ -107,7 +108,9 @@ def train(args, world_size):
             print(f"resumed from step {int(state.step)}")
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
                       zero=args.zero, grad_compress=args.grad_compress,
-                      error_feedback=not args.no_error_feedback)
+                      error_feedback=not args.no_error_feedback,
+                      overlap_grad_sync=args.overlap_grad_sync,
+                      bucket_mb=args.bucket_mb)
     dstate = dp.shard_state(state)
 
     def step(s, images_np, labels_np):
@@ -116,7 +119,8 @@ def train(args, world_size):
     trainer = Trainer(step, log_every=args.log_every, log_rank=0,
                       ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
                       state_for_checkpoint=dp.unshard_state)
-    dstate = trainer.fit(dstate, loader, args.epochs, set_epoch=False)
+    dstate = trainer.fit(dstate, loader, args.epochs, set_epoch=False,
+                         prefetch=args.prefetch)
     if args.ckpt_dir:
         from tpu_sandbox.train import checkpoint as ckpt
 
@@ -207,11 +211,14 @@ def train_multiprocess_worker(args, world_size):
 
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
                       zero=args.zero, grad_compress=args.grad_compress,
-                      error_feedback=not args.no_error_feedback)
+                      error_feedback=not args.no_error_feedback,
+                      overlap_grad_sync=args.overlap_grad_sync,
+                      bucket_mb=args.bucket_mb)
     dstate = dp.shard_state(state)
     trainer = Trainer(dp.train_step, log_every=args.log_every, log_rank=0,
                       verbose=rank == 0)
-    trainer.fit(dstate, GlobalLoader(), args.epochs, set_epoch=False)
+    trainer.fit(dstate, GlobalLoader(), args.epochs, set_epoch=False,
+                prefetch=args.prefetch)
     bootstrap.cleanup()
     if hb is not None:
         hb.stop(deregister=True)
@@ -319,7 +326,9 @@ def train_elastic_worker(args, world_size):
     dp = DataParallel(model, tx, mesh, image_size=tuple(image_shape),
                       zero=args.zero, donate=False,
                       grad_compress=args.grad_compress,
-                      error_feedback=not args.no_error_feedback)
+                      error_feedback=not args.no_error_feedback,
+                      overlap_grad_sync=args.overlap_grad_sync,
+                      bucket_mb=args.bucket_mb)
 
     # per-boundary preemption vote: OR this rank's flag across the world
     # through a real collective, so every rank reaches the same stop
@@ -357,7 +366,7 @@ def train_elastic_worker(args, world_size):
             ckpt_every=args.ckpt_every, preemption=preemption,
             agree_fn=agree_preempt if world_size > 1 else None,
             injector=injector, log_every=args.log_every, log_rank=rank,
-            verbose=rank == 0, set_epoch=False,
+            verbose=rank == 0, set_epoch=False, prefetch=args.prefetch,
         )
         if rank == 0:
             resumed = (f"resumed from step {report.resumed_step}"
@@ -421,6 +430,12 @@ def _elastic_passthrough(args):
         passthrough += ["--grad-compress", args.grad_compress]
     if args.no_error_feedback:
         passthrough += ["--no-error-feedback"]
+    if args.overlap_grad_sync:
+        passthrough += ["--overlap-grad-sync"]
+    if args.bucket_mb != 25.0:
+        passthrough += ["--bucket-mb", str(args.bucket_mb)]
+    if args.prefetch:
+        passthrough += ["--prefetch"]
     return passthrough
 
 
@@ -529,9 +544,12 @@ def run_host_agent(args, world_size):
         )
     server = None
     if args.leader:
-        server = KVServer(port=int(args.kv_port or 0))
-        print(f"[agent {args.agent_id}] hosting KV store on port "
-              f"{server.port}", flush=True)
+        # bind/token make the store reachable off-host: --kv-bind 0.0.0.0
+        # + TPU_SANDBOX_KV_TOKEN in the env (KVServer/KVClient both read
+        # it, so workers inherit the secret without a flag)
+        server = KVServer(port=int(args.kv_port or 0), bind=args.kv_bind)
+        print(f"[agent {args.agent_id}] hosting KV store on "
+              f"{args.kv_bind}:{server.port}", flush=True)
         kv_port = server.port
     elif args.kv_port:
         kv_port = int(args.kv_port)
@@ -628,6 +646,12 @@ def spawn_multiprocess(args, world_size):
         passthrough += ["--grad-compress", args.grad_compress]
     if args.no_error_feedback:
         passthrough += ["--no-error-feedback"]
+    if args.overlap_grad_sync:
+        passthrough += ["--overlap-grad-sync"]
+    if args.bucket_mb != 25.0:
+        passthrough += ["--bucket-mb", str(args.bucket_mb)]
+    if args.prefetch:
+        passthrough += ["--prefetch"]
     procs = [
         subprocess.Popen(cmd_base + ["--rank", str(r)] + passthrough)
         for r in range(world_size)
@@ -717,6 +741,7 @@ def main():
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
     add_checkpoint_cli(parser)
     add_grad_compress_cli(parser)
+    add_overlap_cli(parser)
     parser.add_argument("--force-cpu", action="store_true",
                         help="use virtual CPU devices even if an accelerator is present")
     parser.add_argument("--multiprocess", action="store_true",

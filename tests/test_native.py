@@ -172,3 +172,51 @@ def test_kvstore_multiprocess_rendezvous():
         for p in procs:
             p.join(timeout=5)
     assert all(got == [0, 1, 2] for _, got in results), results
+
+
+@needs_native
+def test_kvstore_token_auth(monkeypatch):
+    """Shared-secret hello frame: a tokened server serves only connections
+    that present the matching token first; a tokenless server ignores the
+    whole mechanism (including a client that sends a hello anyway)."""
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+    monkeypatch.delenv("TPU_SANDBOX_KV_TOKEN", raising=False)
+    with KVServer(token="s3cret") as srv:
+        with KVClient(port=srv.port, token="s3cret") as c:
+            c.set("k", b"v")
+            assert c.get("k") == b"v"
+            with c.clone() as c2:  # clone re-authenticates
+                assert c2.get("k") == b"v"
+        with pytest.raises(ConnectionError, match="token"):
+            KVClient(port=srv.port, token="wrong")
+        # no token at all: the TCP connect succeeds but the first store op
+        # is rejected before touching the map
+        c3 = KVClient(port=srv.port)
+        try:
+            with pytest.raises(RuntimeError):
+                c3.get("k")
+        finally:
+            c3.close()
+    with KVServer() as srv:  # tokenless server: hello is a harmless no-op
+        with KVClient(port=srv.port, token="ignored") as c:
+            c.set("k", b"v")
+            assert c.get("k") == b"v"
+
+
+@needs_native
+def test_kvstore_env_token_and_bind_all(monkeypatch):
+    """TPU_SANDBOX_KV_TOKEN is the default token for BOTH ends (respawned
+    workers inherit auth through the environment), and bind="0.0.0.0"
+    accepts non-loopback-addressed connections."""
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+    monkeypatch.setenv("TPU_SANDBOX_KV_TOKEN", "env-tok")
+    with KVServer(bind="0.0.0.0") as srv:
+        assert srv.token == "env-tok"
+        with KVClient(port=srv.port) as c:  # token from env, no kwarg
+            assert c.token == "env-tok"
+            assert c.add("n", 1) == 1
+        monkeypatch.delenv("TPU_SANDBOX_KV_TOKEN")
+        with pytest.raises(ConnectionError, match="token"):
+            KVClient(port=srv.port, token="not-it")

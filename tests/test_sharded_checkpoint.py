@@ -197,6 +197,50 @@ def test_fold_per_replica_and_reshard(tmp_path):
     )
 
 
+def test_restore_partial_bitwise_slice_of_full(tmp_path):
+    """Partial restore (this rank's shard + rank 0's) returns bitwise the
+    same values a full reassembly would slice out for this rank."""
+    world = 4
+    locals_, spec, template, _ = _tree(20, world=world)
+    cks = _ckpts(tmp_path / "ck", world=world)
+    _save_all(cks, locals_, spec, 5, epoch=2, offset=9)
+    ftree, _ = cks[0].restore(template)
+    for r, ck in enumerate(cks):
+        tree, meta = ck.restore_partial(template)
+        np.testing.assert_array_equal(tree["w"], ftree["w"])
+        # sharded leaves come back as THIS RANK's block, i.e. the rank-r
+        # slice of the full reassembly — and bitwise what rank r saved
+        np.testing.assert_array_equal(
+            tree["mom"], ftree["mom"][r * 2:(r + 1) * 2])
+        np.testing.assert_array_equal(tree["mom"], locals_[r]["mom"])
+        np.testing.assert_array_equal(tree["bn"], ftree["bn"][r:r + 1])
+        assert (meta["step"], meta["epoch"], meta["offset"]) == (5, 2, 9)
+        assert meta["world_size"] == world
+
+
+def test_restore_partial_world_change_and_strictness(tmp_path):
+    locals_, spec, template, _ = _tree(21)
+    cks = _ckpts(tmp_path / "ck")
+    _save_all(cks, locals_, spec, 3)
+    # a changed world size must refuse: resharding is restore()'s job
+    grown = ShardedCheckpoint(tmp_path / "ck", rank=0,
+                              world_size=WORLD + 1, verbose=False)
+    with pytest.raises(ValueError, match="unchanged world size"):
+        grown.restore_partial(template, step=3)
+    # only the shards actually read are hashed: rank 0 never touches
+    # rank 1's rotten file, rank 1 fails loud on it
+    corrupt_latest_shard(tmp_path / "ck", rank=1)
+    tree, meta = cks[0].restore_partial(template)
+    assert meta["step"] == 3
+    np.testing.assert_array_equal(tree["mom"], locals_[0]["mom"])
+    with pytest.raises(ValueError, match="shard 1"):
+        cks[1].restore_partial(template)
+    assert cks[1].step_dir(3).exists()  # strict: nothing quarantined
+    empty = ShardedCheckpoint(tmp_path / "none", rank=0,
+                              world_size=WORLD, verbose=False)
+    assert empty.restore_partial(template) is None
+
+
 def test_verifier_scan_quarantines_bitrot(tmp_path):
     locals_, spec, template, _ = _tree(9)
     cks = _ckpts(tmp_path / "ck")

@@ -43,7 +43,13 @@ def unwrap_cost(compiled) -> dict:
     return ca
 
 
-def make_topology():
+def make_topology(topology_name: str = "v5e:1x1x1",
+                  chips_per_host_bounds=(1, 1, 1)):
+    """Compile-only TPU topology. The 1x1x1 default is the single-chip
+    memory/FLOPs twin; multi-chip bounds (e.g. ``"v5e:2x2x1"``,
+    ``(2, 2, 1)``) give tools that need real cross-chip collectives in the
+    compiled HLO — the schedule receipt in tools/hlo_schedule.py — a mesh
+    to compile against."""
     # env setup lives HERE, not at module import: importing this module
     # (e.g. tests importing hlo_traffic for its classifier) must not
     # flip the whole process into forced-compiled-kernel mode — that
@@ -60,8 +66,8 @@ def make_topology():
     from jax.experimental import topologies
 
     return topologies.get_topology_desc(
-        platform="tpu", topology_name="v5e:1x1x1",
-        chips_per_host_bounds=[1, 1, 1],
+        platform="tpu", topology_name=topology_name,
+        chips_per_host_bounds=list(chips_per_host_bounds),
     )
 
 

@@ -7,13 +7,18 @@ torch DataLoader (mnist_onegpu.py:55-59), DistributedSampler
 on-device inside the train step.
 """
 
-from tpu_sandbox.data.loader import BatchLoader, ShardedBatchLoader
+from tpu_sandbox.data.loader import (
+    BatchLoader,
+    PrefetchLoader,
+    ShardedBatchLoader,
+)
 from tpu_sandbox.data.mnist import load_mnist, normalize, synthetic_mnist
 from tpu_sandbox.data.sampler import DistributedSampler
 
 __all__ = [
     "BatchLoader",
     "DistributedSampler",
+    "PrefetchLoader",
     "ShardedBatchLoader",
     "load_mnist",
     "normalize",

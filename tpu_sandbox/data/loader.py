@@ -13,6 +13,8 @@ shards those rows onto the 'data' mesh axis.
 from __future__ import annotations
 
 import math
+import queue
+import threading
 
 import numpy as np
 
@@ -136,3 +138,82 @@ class ShardedBatchLoader:
                  for idx in per_rank]
             )
             yield self.images[sel], self.labels[sel]
+
+
+class PrefetchLoader:
+    """Double-buffered background prefetch over any loader.
+
+    While the device runs step N, a daemon thread assembles (and, when
+    ``stage`` is given, device-places) batch N+1 — the input pipeline's
+    half of the overlapped step. ``depth=2`` is classic double buffering:
+    one batch in flight on device, one staged behind it; deeper queues buy
+    nothing once the producer keeps one step ahead, and would hold that
+    many extra batches in memory.
+
+    ``stage``: optional ``(images, labels) -> staged_batch`` callable run
+    in the producer thread — pass an engine's ``shard_batch`` so the
+    host→device transfer itself overlaps the previous step's compute
+    instead of serializing in front of it.
+
+    Determinism contract (elastic resume depends on it): a single producer
+    feeding a FIFO queue yields exactly the wrapped loader's batches in
+    exactly its order, and ``set_epoch``/``__len__`` delegate — so the
+    (epoch, offset) metadata the resumable loop checkpoints means the same
+    thing with or without the prefetcher. The producer is a daemon thread,
+    stopped and joined when iteration ends for ANY reason (exhaustion,
+    preemption raising out of the loop, a consumer break).
+    """
+
+    def __init__(self, loader, *, depth: int = 2, stage=None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.loader = loader
+        self.depth = depth
+        self.stage = stage
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(self.depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that a consumer-side stop can always unstick
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self.loader:
+                    if self.stage is not None:
+                        batch = self.stage(*batch)
+                    if not put(("batch", batch)):
+                        return
+                put(("done", None))
+            except BaseException as e:  # re-raised on the consumer side
+                put(("error", e))
+
+        t = threading.Thread(
+            target=produce, name="prefetch-loader", daemon=True
+        )
+        t.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "done":
+                    return
+                if kind == "error":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            t.join(timeout=5.0)

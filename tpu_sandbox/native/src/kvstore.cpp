@@ -24,6 +24,11 @@
 //             (fault claims, checkpoint shard-done claims) must not
 //             accumulate across supervisor generations on a long-lived
 //             server, nor alias a later generation's claims.
+//             'H' hello (key = shared-secret token): when the server was
+//             started with a token, this must be the FIRST frame on every
+//             connection — wrong/missing token gets status 1 and the
+//             socket closed. On a token-less server 'H' is a no-op, so
+//             clients send it unconditionally whenever they hold a token.
 // C ABI at the bottom; Python wrapper in tpu_sandbox/runtime/kvstore.py.
 
 #include <arpa/inet.h>
@@ -51,6 +56,7 @@ using Clock = std::chrono::steady_clock;
 struct Server {
   int listen_fd = -1;
   int port = 0;
+  std::string token;  // empty = no authentication (loopback deployments)
   std::map<std::string, std::string> data;
   std::map<std::string, Clock::time_point> expiry;  // keys set with TTL
   std::mutex mu;
@@ -119,13 +125,49 @@ bool key_alive(Server* srv, const std::string& key) {
   return it == srv->expiry.end() || it->second > Clock::now();
 }
 
+// Shared-secret handshake: when the server carries a token, the FIRST
+// frame of every connection must be op 'H' with key == token. Constant
+// framing (same request shape as every other op) keeps the client code
+// one line; a wrong/missing token gets one error response and the socket
+// closed before any store op is served.
+bool authenticate(Server* srv, int fd) {
+  if (srv->token.empty()) return true;
+  uint8_t op;
+  std::string key, val;
+  if (!read_exact(fd, &op, 1) || !read_blob(fd, key) || !read_blob(fd, val))
+    return false;
+  if (op != 'H' || key != srv->token) {
+    write_response(fd, 1, "auth required");
+    return false;
+  }
+  return write_response(fd, 0, "");
+}
+
+void serve_loop(Server* srv, int fd);
+
 void serve_conn(Server* srv, int fd) {
+  if (authenticate(srv, fd)) serve_loop(srv, fd);
+  {
+    // deregister before closing: fd numbers get reused, and a stale entry
+    // in conn_fds would make stop() shutdown() an unrelated future socket
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    auto& v = srv->conn_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+  }
+  ::close(fd);
+}
+
+void serve_loop(Server* srv, int fd) {
   for (;;) {
     uint8_t op;
     if (!read_exact(fd, &op, 1)) break;
     std::string key, val;
     if (!read_blob(fd, key) || !read_blob(fd, val)) break;
-    if (op == 'S') {
+    if (op == 'H') {
+      // hello to an unauthenticated server (client env carries a token the
+      // server doesn't): harmless no-op, keeps client setup unconditional
+      if (!write_response(fd, 0, "")) break;
+    } else if (op == 'S') {
       {
         std::lock_guard<std::mutex> lk(srv->mu);
         purge_expired(srv);
@@ -229,28 +271,29 @@ void serve_conn(Server* srv, int fd) {
       break;
     }
   }
-  {
-    // deregister before closing: fd numbers get reused, and a stale entry
-    // in conn_fds would make stop() shutdown() an unrelated future socket
-    std::lock_guard<std::mutex> lk(srv->conns_mu);
-    auto& v = srv->conn_fds;
-    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
-  }
-  ::close(fd);
 }
 
 }  // namespace
 
 extern "C" {
 
-Server* kv_server_start(int port) {
+// bind_addr: dotted-quad listen address — nullptr/"" means loopback (the
+// safe single-host default); "0.0.0.0" opens the store to the network for
+// real cross-host deployment, which is what token (nullptr/"" = no auth)
+// exists for: every connection must then open with the shared secret.
+Server* kv_server_start(const char* bind_addr, int port, const char* token) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind_addr == nullptr || bind_addr[0] == '\0') {
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  } else if (::inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
   addr.sin_port = htons((uint16_t)port);
   if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || ::listen(fd, 64) != 0) {
     ::close(fd);
@@ -262,6 +305,7 @@ Server* kv_server_start(int port) {
   auto* srv = new Server();
   srv->listen_fd = fd;
   srv->port = ntohs(addr.sin_port);
+  if (token != nullptr) srv->token = token;
   srv->acceptor = std::thread([srv] {
     for (;;) {
       int cfd = ::accept(srv->listen_fd, nullptr, nullptr);

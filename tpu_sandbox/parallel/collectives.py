@@ -399,6 +399,21 @@ class CompressedAllReduce:
     def needs_residual(self) -> bool:
         return self.mode == "int8" and self.error_feedback
 
+    def block_for(self, n: int, size: int) -> int:
+        """Per-leaf int8 block size over the sync axis (``size`` is the
+        mesh's slowest — DCN at pod scale — axis, the one the exchange
+        crosses). ``block`` is the ceiling; leaves whose per-rank chunk is
+        smaller than one block shrink it by halving (floor 8), because
+        :func:`int8_block_pmean` pads each rank's chunk to a block multiple
+        and a 16-element bias padded to 256 would ship 16x its payload in
+        alignment zeros. Leaves at or above one block per rank keep the
+        configured granularity (and its ``4 / block`` scale overhead)."""
+        per_rank = -(-int(n) // size)
+        b = self.block
+        while b > 8 and b > per_rank:
+            b //= 2
+        return b
+
     def pmean(self, value, axis_name, size: int, residual=None):
         """Compressed mean of one array across ``axis_name`` (inside
         ``shard_map``). Returns ``(mean, new_residual)``."""
@@ -412,7 +427,9 @@ class CompressedAllReduce:
             )
         if not self.error_feedback:
             residual = None
-        return int8_block_pmean(value, residual, axis_name, size, self.block)
+        return int8_block_pmean(
+            value, residual, axis_name, size, self.block_for(value.size, size)
+        )
 
     def pmean_tree(self, grads, axis_name, size: int, residuals=None):
         """:meth:`pmean` over a pytree. ``residuals`` is None (no error
@@ -448,7 +465,8 @@ class CompressedAllReduce:
         plus block/axis-alignment padding on both shots), so the all-in
         ``total`` never hides it. fp32/bf16 count the all-reduce operand;
         int8 counts both shots' operands (all_to_all + re-quantized
-        all_gather)."""
+        all_gather). Block sizes follow :meth:`block_for` per leaf, the
+        same rule the on-wire path uses, so this stays the HLO's mirror."""
         payload = total = 0
         for n in leaf_sizes:
             n = int(n)
@@ -459,8 +477,9 @@ class CompressedAllReduce:
                 payload += 2 * n
                 total += 2 * n
             else:
-                chunk = -(-n // (size * self.block)) * self.block
-                nb = chunk // self.block
+                block = self.block_for(n, size)
+                chunk = -(-n // (size * block)) * block
+                nb = chunk // block
                 # shot 1 (q + scales) + shot 2 (q2 + scales); payload is
                 # the unpadded elements crossing once per shot pair
                 payload += n + -(-n // size)

@@ -63,6 +63,8 @@ class DataParallel:
         donate: bool = True,
         grad_compress: str | CompressedAllReduce = "none",
         error_feedback: bool = True,
+        overlap_grad_sync: bool = False,
+        bucket_mb: float = 25.0,
     ):
         """``zero=True`` is ZeRO-1 (optimizer-state sharding): optimizer
         state lives sharded over the data axis (dim 0, leaves whose leading
@@ -92,7 +94,19 @@ class DataParallel:
         compressed mean replaces BOTH the psum_scatter and pmean branches:
         wire compression is kept, but the scatter-only half-volume trick is
         traded away (each rank slices its block from the full compressed
-        mean)."""
+        mean).
+
+        ``overlap_grad_sync`` buckets the gradient sync (DDP's reducer):
+        grads are grouped into ``bucket_mb``-targeted flat buffers
+        (parallel/buckets.py) and each bucket is one independent collective,
+        giving XLA's latency-hiding scheduler the freedom to start a
+        bucket's all-reduce while later backward dots still run. Composes
+        with every ``grad_compress`` mode (buckets quantize as units, with
+        per-bucket error-feedback residuals that still checkpoint
+        leaf-shaped) and with ``zero`` (full bucketed mean, then each rank
+        slices its block — same trade as compression). Off by default:
+        overlap off + ``grad_compress='none'`` is byte-for-byte the
+        monolithic path."""
         if axis not in mesh.axis_names:
             raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
         self.model = model
@@ -110,6 +124,10 @@ class DataParallel:
                 mode=str(grad_compress) if grad_compress else "none",
                 error_feedback=error_feedback,
             )
+        if bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be positive, got {bucket_mb}")
+        self.overlap = bool(overlap_grad_sync)
+        self.bucket_bytes = int(bucket_mb * 2 ** 20)
         self._build(donate)
 
     def _dim0_sharded(self, leaf) -> bool:
@@ -283,6 +301,7 @@ class DataParallel:
         image_size, average_loss = self.image_size, self.average_loss
         zero, size, dim0_sharded = self.zero, self.size, self._dim0_sharded
         compress = self.compress
+        overlap, bucket_bytes = self.overlap, self.bucket_bytes
 
         def loss_fn(params, batch_stats, images, labels):
             variables = {"params": params}
@@ -306,17 +325,27 @@ class DataParallel:
                 state.params, local_stats, images, labels
             )
             new_residual = state.grad_residual
-            if compress.mode != "none":
-                # Compressed sync happens ONCE here for every leaf; the
-                # branches below then consume already-mean'd grads. (Under
-                # ZeRO this supersedes the psum_scatter half-volume trick —
-                # the wire carries the compressed payload instead.)
+            if overlap or compress.mode != "none":
+                # Sync happens ONCE here for every leaf; the branches below
+                # then consume already-mean'd grads. (Under ZeRO this
+                # supersedes the psum_scatter half-volume trick — the wire
+                # carries the bucketed/compressed payload instead.)
                 local_res = (
                     jax.tree.map(lambda x: x[0], state.grad_residual)
                     if compress.needs_residual
                     else None
                 )
-                grads, new_res = compress.pmean_tree(grads, axis, size, local_res)
+                if overlap:
+                    from tpu_sandbox.parallel.buckets import sync_buckets
+
+                    grads, new_res = sync_buckets(
+                        grads, axis, size, compress, residuals=local_res,
+                        bucket_bytes=bucket_bytes,
+                    )
+                else:
+                    grads, new_res = compress.pmean_tree(
+                        grads, axis, size, local_res
+                    )
                 if compress.needs_residual:
                     new_residual = jax.tree.map(lambda x: x[None], new_res)
             if zero:
@@ -337,9 +366,9 @@ class DataParallel:
                 params_blk = jax.tree.map(
                     lambda p, s: blk(p) if s else p, state.params, sharded
                 )
-                if compress.mode != "none":
-                    # already mean'd by the compressed sync above — each
-                    # rank just slices its own block
+                if overlap or compress.mode != "none":
+                    # already mean'd by the bucketed/compressed sync above —
+                    # each rank just slices its own block
                     grads_blk = jax.tree.map(
                         lambda g, s: blk(g) if s else g, grads, sharded
                     )
@@ -363,10 +392,8 @@ class DataParallel:
                     new_blk, sharded,
                 )
             else:
-                if compress.mode == "none":
-                    # THE data-parallel step: mean grads across ranks. XLA
-                    # overlaps this with the rest of backprop (DDP's
-                    # bucketing, compiled).
+                if not overlap and compress.mode == "none":
+                    # THE data-parallel step: mean grads across ranks.
                     grads = lax.pmean(grads, axis)
                 updates, new_opt = tx.update(
                     grads, state.opt_state, state.params

@@ -191,6 +191,8 @@ class PjitEngine:
         fsdp_axis: str | None = None,
         donate: bool = True,
         grad_compress: str | CompressedAllReduce = "none",
+        overlap_grad_sync: bool = False,
+        bucket_mb: float = 25.0,
     ):
         if task not in ("image", "lm"):
             raise ValueError(f"task must be 'image' or 'lm', got {task!r}")
@@ -235,30 +237,37 @@ class PjitEngine:
         self.zero_axis = zero_axis
         self.fsdp_axis = fsdp_axis
         self.donate = donate
-        # Compressed grad sync needs the gradients to cross exactly ONE
-        # mesh axis (the batch axis) in a known place, so it is spelled as
-        # an explicit shard_map wrapped around the grad computation. That
-        # only composes with pure data parallelism: under TP rules / FSDP /
-        # spatial input specs, XLA owns where the collectives go and we
-        # cannot intercept them. zero_axis is fine (the sharding mismatch
-        # is between replicated grads and sharded moments, downstream of
-        # the sync). Stateless here: no error-feedback residual — use
-        # DataParallel for int8 + error feedback.
+        # Compressed/bucketed grad sync needs the gradients to cross
+        # exactly ONE mesh axis (the batch axis) in a known place, so it is
+        # spelled as an explicit shard_map wrapped around the grad
+        # computation. That only composes with pure data parallelism: under
+        # TP rules / FSDP / spatial input specs, XLA owns where the
+        # collectives go and we cannot intercept them. zero_axis is fine
+        # (the sharding mismatch is between replicated grads and sharded
+        # moments, downstream of the sync). Stateless here: no
+        # error-feedback residual — use DataParallel for int8 + error
+        # feedback.
         self.grad_compress = as_compress_policy(grad_compress)
-        if self.grad_compress.mode != "none":
+        if bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be positive, got {bucket_mb}")
+        self.overlap = bool(overlap_grad_sync)
+        self.bucket_bytes = int(bucket_mb * 2 ** 20)
+        if self.grad_compress.mode != "none" or self.overlap:
+            what = ("grad_compress" if self.grad_compress.mode != "none"
+                    else "overlap_grad_sync")
             if self.rules:
                 raise ValueError(
-                    "grad_compress composes only with pure data parallelism; "
-                    "drop the TP rules or use grad_compress='none'"
+                    f"{what} composes only with pure data parallelism; "
+                    f"drop the TP rules or disable {what}"
                 )
             if self.fsdp_axis is not None:
                 raise ValueError(
-                    "grad_compress does not compose with fsdp_axis (FSDP's "
+                    f"{what} does not compose with fsdp_axis (FSDP's "
                     "reduce-scatter is compiler-inserted)"
                 )
             if self.input_spec != P(self.batch_axis):
                 raise ValueError(
-                    f"grad_compress needs input_spec == P({self.batch_axis!r}) "
+                    f"{what} needs input_spec == P({self.batch_axis!r}) "
                     f"(batch-only sharding), got {self.input_spec}"
                 )
         self._jitted: Callable | None = None
@@ -324,13 +333,14 @@ class PjitEngine:
                 )
 
         compress = self.grad_compress
-        if compress.mode != "none":
+        overlap, bucket_bytes = self.overlap, self.bucket_bytes
+        if compress.mode != "none" or overlap:
             if jax.tree.leaves(state.batch_stats):
                 raise ValueError(
-                    "grad_compress under PjitEngine requires a BN-free "
-                    "model: batch stats mutate per data shard inside the "
-                    "grad shard_map and cannot be returned replicated. Use "
-                    "DataParallel (per-replica BN) instead."
+                    "grad_compress/overlap_grad_sync under PjitEngine "
+                    "requires a BN-free model: batch stats mutate per data "
+                    "shard inside the grad shard_map and cannot be returned "
+                    "replicated. Use DataParallel (per-replica BN) instead."
                 )
             from jax import lax
 
@@ -343,7 +353,15 @@ class PjitEngine:
                 (loss, _), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params, {}, images, labels)
-                grads, _ = compress.pmean_tree(grads, axis, size, None)
+                if overlap:
+                    from tpu_sandbox.parallel.buckets import sync_buckets
+
+                    grads, _ = sync_buckets(
+                        grads, axis, size, compress,
+                        bucket_bytes=bucket_bytes,
+                    )
+                else:
+                    grads, _ = compress.pmean_tree(grads, axis, size, None)
                 return lax.pmean(loss, axis), grads
 
             grads_fn = shard_map(

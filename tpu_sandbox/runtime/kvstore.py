@@ -11,10 +11,19 @@ the multi-process CPU test strategy.
 from __future__ import annotations
 
 import ctypes
+import os
 import random
 import threading
 import time
 import weakref
+
+
+def _env_token() -> str | None:
+    """Default shared-secret for both ends: set TPU_SANDBOX_KV_TOKEN on
+    every host of a cross-host job and servers require it, clients send it
+    — respawned workers inherit the auth story through the environment
+    with no extra flag plumbing."""
+    return os.environ.get("TPU_SANDBOX_KV_TOKEN") or None
 
 
 def _lib() -> ctypes.CDLL:
@@ -27,7 +36,9 @@ def _lib() -> ctypes.CDLL:
 
     lib = load_library("kvstore")
     lib.kv_server_start.restype = ctypes.c_void_p
-    lib.kv_server_start.argtypes = [ctypes.c_int]
+    lib.kv_server_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+    ]
     lib.kv_server_port.restype = ctypes.c_int
     lib.kv_server_port.argtypes = [ctypes.c_void_p]
     lib.kv_server_stop.restype = None
@@ -58,14 +69,32 @@ def live_servers() -> list["KVServer"]:
 
 
 class KVServer:
-    """In-process store server (rank 0 runs one). port=0 -> OS-assigned."""
+    """In-process store server (rank 0 runs one). port=0 -> OS-assigned.
 
-    def __init__(self, port: int = 0):
+    ``bind`` defaults to loopback — the single-host topology needs nothing
+    more, and an open port with no auth is not a default anyone should
+    inherit. Cross-host deployment: ``bind="0.0.0.0"`` plus a shared-secret
+    ``token`` (default: the TPU_SANDBOX_KV_TOKEN env var), which every
+    connection must present in an opening hello frame before any store op
+    is served. Auth without transport encryption: the token gates access,
+    it does not hide traffic from the network path — run on a trusted
+    fabric (DCN) or tunnel."""
+
+    def __init__(self, port: int = 0, *, bind: str = "127.0.0.1",
+                 token: str | None = None):
+        if token is None:
+            token = _env_token()
         self._lib = _lib()
-        self._handle = self._lib.kv_server_start(port)
+        self._handle = self._lib.kv_server_start(
+            bind.encode(), port, (token or "").encode()
+        )
         if not self._handle:
-            raise RuntimeError(f"kv_server_start failed on port {port}")
+            raise RuntimeError(
+                f"kv_server_start failed on {bind}:{port}"
+            )
         self.port = self._lib.kv_server_port(self._handle)
+        self.bind = bind
+        self.token = token
         _live_servers.add(self)
 
     def stop(self) -> None:
@@ -87,14 +116,22 @@ class KVClient:
         port: int = 0,
         *,
         connect_timeout: float = 10.0,
+        token: str | None = None,
     ):
         """Connect with bounded retry: worker processes race the rank-0
         server's listen() (an elastic restart relaunches everyone at once),
         so a refused connection within ``connect_timeout`` seconds is
         "server not up yet", not an error. ``connect_timeout=0`` restores
-        the old single-attempt behavior."""
+        the old single-attempt behavior.
+
+        ``token`` (default: the TPU_SANDBOX_KV_TOKEN env var) is sent as
+        the opening hello frame of every connection — required by servers
+        started with a token, a no-op against servers without one."""
+        if token is None:
+            token = _env_token()
         self._lib = _lib()
         self.host, self.port = host, port
+        self.token = token
         self.connect_timeout = connect_timeout
         deadline = time.monotonic() + connect_timeout
         delay = 0.02
@@ -109,10 +146,28 @@ class KVClient:
                 )
             time.sleep(delay)
             delay = min(delay * 2, 0.5)
+        self._hello()
         # one request-response in flight per connection: the wire protocol is
         # length-prefixed with no framing recovery, so concurrent callers
         # (e.g. a Heartbeat thread sharing the owner's client) must serialize
         self._mu = threading.Lock()
+
+    def _hello(self) -> None:
+        """Authenticate this connection (first frame, before any store op).
+        Raw kv_request on purpose: runs inside _reconnect, which executes
+        under _request's lock — re-entering _request would deadlock."""
+        if not self.token:
+            return
+        tok = self.token.encode()
+        out = ctypes.create_string_buffer(8)
+        n = self._lib.kv_request(self._fd, b"H", tok, len(tok), b"", 0, out, 8)
+        if n < 0:
+            self._lib.kv_close(self._fd)
+            self._fd = -1
+            raise ConnectionError(
+                f"kv auth to {self.host}:{self.port} failed — token "
+                "rejected (TPU_SANDBOX_KV_TOKEN mismatch?)"
+            )
 
     # Idempotent reads may be transparently retried on a fresh connection
     # after a transient socket error: re-running them cannot change store
@@ -134,6 +189,7 @@ class KVClient:
         while True:
             self._fd = self._lib.kv_connect(self.host.encode(), self.port)
             if self._fd >= 0:
+                self._hello()
                 return
             if time.monotonic() >= deadline:
                 raise ConnectionError(
@@ -197,7 +253,7 @@ class KVClient:
         """A fresh connection to the same store. Background users (e.g. a
         Heartbeat) should run on a clone: a blocking ``get`` holds this
         connection's request lock for its whole server-side wait."""
-        return KVClient(self.host, self.port)
+        return KVClient(self.host, self.port, token=self.token)
 
     def try_get(self, key: str) -> bytes | None:
         """Non-blocking get: ``None`` when the key does not exist (the poll

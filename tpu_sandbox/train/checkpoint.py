@@ -861,6 +861,92 @@ class ShardedCheckpoint:
                 meta[k] = v
         return jax.tree_util.tree_unflatten(treedef, restored), meta
 
+    def restore_partial(self, template, step: int | None = None):
+        """-> (tree, meta) reading only THIS RANK's slice of the step: rank
+        0's shard (replicated leaves) plus this rank's own shard (sharded
+        leaves hold the rank's saved block, not the reassembled global
+        value) — two files and two hash passes instead of ``world_size``,
+        the restart fast path when placement did not change. Requires the
+        manifest's ``world_size`` to equal this instance's (``ValueError``
+        otherwise — a changed world needs :meth:`restore`'s full
+        reassembly, which is what reshards). Strict like explicit-step
+        :meth:`restore`: any problem raises, nothing is quarantined and no
+        older step is tried. ``step=None`` picks the latest sealed step
+        (``None`` when there is none). Checksums are verified for exactly
+        the shards read."""
+        if step is None:
+            step = self.latest_sealed_step()
+            if step is None:
+                return None
+        sd = self.step_dir(step)
+        mf = sd / MANIFEST_NAME
+        if not mf.exists():
+            raise ValueError(f"step {step} is not sealed (no manifest)")
+        manifest = json.loads(mf.read_text())
+        if manifest.get("format") != MANIFEST_FORMAT:
+            raise ValueError(
+                f"unknown manifest format {manifest.get('format')!r}"
+            )
+        if int(manifest["world_size"]) != self.world_size:
+            raise ValueError(
+                f"partial restore needs an unchanged world size: step "
+                f"{step} was written by {manifest['world_size']} ranks, "
+                f"this rank is {self.rank} of {self.world_size} — use "
+                "restore(), whose full reassembly is what reshards"
+            )
+        by_rank = {sh["rank"]: sh for sh in manifest["shards"]}
+        shard_data: dict[int, dict] = {}
+        shard_dtypes: dict[int, dict] = {}
+        for r in sorted({0, self.rank}):
+            sh = by_rank.get(r)
+            if sh is None:
+                raise ValueError(f"manifest misses shard for rank {r}")
+            f = sd / sh["file"]
+            if not f.exists():
+                raise ValueError(f"shard {r} missing ({sh['file']})")
+            size = f.stat().st_size
+            if size != sh["bytes"]:
+                raise ValueError(
+                    f"shard {r} is {size} bytes, manifest says {sh['bytes']}"
+                )
+            digest = _sha256_file(f)
+            if digest != sh["sha256"]:
+                raise ValueError(
+                    f"shard {r} sha256 {digest[:12]}... != manifest "
+                    f"{sh['sha256'][:12]}..."
+                )
+            with np.load(f, allow_pickle=False) as z:
+                meta = json.loads(str(z["__meta__"]))
+                shard_data[r] = {
+                    k[len("leaf:"):]: z[k].copy() for k in z.files
+                    if k.startswith("leaf:")
+                }
+                shard_dtypes[r] = meta.get("dtypes", {})
+        spec: dict = manifest["spec"]
+        leaves, treedef = _flatten_with_paths(template)
+        restored = []
+        for path, tleaf in leaves:
+            kind = spec.get(path)
+            if kind is None:
+                raise KeyError(f"manifest misses leaf {path!r}")
+            src = 0 if kind == "rep" else self.rank
+            data = shard_data[src]
+            if path not in data:
+                raise KeyError(f"rank-{src} shard misses leaf {path!r}")
+            arr = _from_savable(data[path], shard_dtypes[src].get(path))
+            if kind == "rep" and tuple(arr.shape) != tuple(np.shape(tleaf)):
+                raise ValueError(
+                    f"leaf {path!r}: checkpoint shape {arr.shape} != "
+                    f"template shape {tuple(np.shape(tleaf))}"
+                )
+            restored.append(arr)
+        meta = {k: manifest[k] for k in ("step", "epoch", "offset",
+                                         "world_size")}
+        for k, v in manifest.items():
+            if k not in ("format", "shards", "spec", *meta):
+                meta[k] = v
+        return jax.tree_util.tree_unflatten(treedef, restored), meta
+
     # -- quarantine / prune ------------------------------------------------
 
     def _quarantine(self, step: int, reason: str) -> Path | None:

@@ -262,10 +262,17 @@ class Trainer:
                 "pass --resume or a fresh --ckpt-dir)"
             )
 
-    def fit(self, state: TrainState, loader, epochs: int, *, set_epoch: bool = False):
+    def fit(self, state: TrainState, loader, epochs: int, *,
+            set_epoch: bool = False, prefetch: bool = False):
         """Run ``epochs`` epochs. ``set_epoch=False`` reproduces the
         reference quirk of never reshuffling the sharded data
-        (no ``sampler.set_epoch``, SURVEY §2.1 C14)."""
+        (no ``sampler.set_epoch``, SURVEY §2.1 C14).
+
+        ``prefetch=True`` wraps the loader in a
+        :class:`~tpu_sandbox.data.loader.PrefetchLoader` (double-buffered
+        background batch assembly) unless it already is one — same batches
+        in the same order, assembled while the previous step runs."""
+        loader = _maybe_prefetch(loader, prefetch)
         start = time.monotonic()
         total_step = len(loader)
         opt_step = int(jax.numpy.ravel(state.step)[0])  # resume-safe seed
@@ -323,6 +330,17 @@ class Trainer:
                                 )
                             )
         return state
+
+
+def _maybe_prefetch(loader, prefetch: bool):
+    """Wrap ``loader`` for background prefetch when asked (idempotent)."""
+    if not prefetch:
+        return loader
+    from tpu_sandbox.data.loader import PrefetchLoader
+
+    if isinstance(loader, PrefetchLoader):
+        return loader
+    return PrefetchLoader(loader)
 
 
 # -- elastic / resumable training -----------------------------------------
@@ -565,6 +583,7 @@ def train_resumable(
     log_rank: int | None = None,
     verbose: bool = True,
     set_epoch: bool = False,
+    prefetch: bool = False,
 ) -> tuple[TrainState, ResumableReport]:
     """The crash-safe epoch loop: checkpoint every ``ckpt_every`` optimizer
     steps *with data-order state*, resume exactly where the stream stood,
@@ -599,7 +618,14 @@ def train_resumable(
     ``save_fn(state, step, epoch, offset)`` keep this loop agnostic of the
     checkpoint backend (orbax single-process, HostCheckpoint
     multi-controller) and of engine sharding.
+
+    ``prefetch=True`` wraps the loader in a background
+    :class:`~tpu_sandbox.data.loader.PrefetchLoader`. The prefetcher's
+    determinism contract (same batches, same order, delegated
+    ``set_epoch``) keeps the (epoch, offset) checkpoint metadata exact, so
+    resume parity is unchanged — tested in tests/test_overlap.py.
     """
+    loader = _maybe_prefetch(loader, prefetch)
     steps_per_epoch = len(loader)
     resumed_step = None
     start_epoch, start_offset = 0, 0

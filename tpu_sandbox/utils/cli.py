@@ -68,6 +68,32 @@ def add_grad_compress_cli(parser, error_feedback: bool = True) -> None:
                                  "the fp32-tracking convergence guarantee)")
 
 
+def add_overlap_cli(parser, prefetch: bool = True) -> None:
+    """Register the overlapped-step-pipeline flag group (same single-site
+    contract as the checkpoint group: launchers and their respawned
+    workers re-parse these exact flags). ``prefetch=False`` for entry
+    scripts with synthetic in-memory streams and no Trainer loop."""
+    parser.add_argument("--overlap-grad-sync", action="store_true",
+                        help="bucket the gradient sync (DDP's reducer): one "
+                             "independent collective per ~--bucket-mb flat "
+                             "buffer so XLA's latency-hiding scheduler can "
+                             "overlap all-reduces with remaining backward "
+                             "compute; composes with --grad-compress "
+                             "(per-bucket quantization + error feedback) "
+                             "and --zero")
+    parser.add_argument("--bucket-mb", type=float, default=25.0,
+                        metavar="MB",
+                        help="with --overlap-grad-sync: bucket size target "
+                             "(default 25, PyTorch DDP's bucket_cap_mb)")
+    if prefetch:
+        parser.add_argument("--prefetch", action="store_true",
+                            help="double-buffered background batch "
+                                 "prefetch: a daemon thread assembles "
+                                 "batch N+1 while step N runs (same "
+                                 "batches, same order — resume parity is "
+                                 "unchanged under --elastic)")
+
+
 def add_elastic_cli(parser) -> None:
     """Register the elastic/agent flag group (same single-site contract as
     the checkpoint group: launchers, agents, and their respawned workers
@@ -96,8 +122,16 @@ def add_elastic_cli(parser) -> None:
                         help="with --agent-id: host the coordination KV "
                              "store inside this agent's process (start "
                              "this agent first; peers connect via "
-                             "--kv-port). Note: the store currently binds "
-                             "loopback only — see ROADMAP")
+                             "--kv-port). Binds loopback by default; pass "
+                             "--kv-bind 0.0.0.0 (+ TPU_SANDBOX_KV_TOKEN) "
+                             "for real cross-host deployment")
+    parser.add_argument("--kv-bind", type=str, default="127.0.0.1",
+                        metavar="ADDR",
+                        help="with --leader: address the KV store listens "
+                             "on (default loopback; 0.0.0.0 for cross-host "
+                             "— set TPU_SANDBOX_KV_TOKEN on every host so "
+                             "connections authenticate with the shared "
+                             "secret)")
 
 
 def _request_cpu_devices(n: int) -> None:
