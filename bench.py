@@ -883,6 +883,39 @@ def bench_overlap(world: int = 8) -> dict:
     }
 
 
+def bench_donation() -> dict:
+    """The donation receipt (graftlint GL-H201's measured counterpart):
+    chipless AOT peak-memory delta between donate=True and donate=False
+    for the DP and ZeRO step compiles, from XLA's memory analysis.
+    Subprocess-isolated like the other AOT paths; the CPU backend cannot
+    witness aliasing, so off-toolchain this degrades to a statement, not
+    a fake zero."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "aot_donation.py")
+    try:
+        out = subprocess.run(
+            [_sys.executable, tool], capture_output=True, text=True,
+            timeout=900,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        tail = (out.stderr or out.stdout).strip().splitlines()
+        err = tail[-1] if tail else f"exit {out.returncode}"
+    except Exception as e:  # missing libtpu, timeout, ...
+        err = f"{type(e).__name__}: {e}"
+    return {
+        "metric": "donation",
+        "degraded": (
+            f"TPU AOT compile unavailable ({err}); the CPU backend does "
+            "not implement buffer donation, so there is no aliasing to "
+            "measure — run on a box with the TPU toolchain"
+        ),
+    }
+
+
 def bench_capacity(image_size: int, dtype_name: str, force_cpu: bool,
                    max_batch: int = 512, plan: str = "auto") -> dict:
     """The reference's published experiment, measured: max batch at
@@ -1463,7 +1496,8 @@ def _chain_attn(fa, q, k, v, n):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
-                   choices=["grad_compress", "overlap", "images_per_sec",
+                   choices=["grad_compress", "overlap", "donation",
+                            "images_per_sec",
                             "allreduce_bw", "pallas",
                             "capacity", "seq_scaling", "lm", "sweep",
                             "convergence"],
@@ -1497,6 +1531,10 @@ def main():
     if args.metric == "overlap":
         # chipless AOT schedule + host-thread stall timing; no probe
         print(json.dumps(bench_overlap()))
+        return
+    if args.metric == "donation":
+        # chipless AOT memory analysis (subprocess-isolated); no probe
+        print(json.dumps(bench_donation()))
         return
     if args.metric != "images_per_sec":
         # probe-timeout 0 means "trust the environment" (same semantics as

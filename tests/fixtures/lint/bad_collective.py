@@ -1,0 +1,45 @@
+"""Seeded GL-C1xx violations — every pattern here must be FLAGGED.
+
+Not imported anywhere; the lint fixture tests feed this file's source to
+``analysis.collective_pass.lint_source`` and assert each rule fires.
+"""
+
+from jax import lax
+
+
+def rank_branch_collective(grads, rank, axis):  # GL-C101
+    if rank == 0:
+        grads = lax.pmean(grads, axis)  # only rank 0 issues the pmean
+    return grads
+
+
+def rank_early_exit(grads, process_index, axis):  # GL-C102
+    if process_index != 0:
+        return grads  # other ranks bail before the collective below
+    return lax.psum(grads, axis)
+
+
+def _helper_syncs(tree, group):
+    return group.all_reduce(tree)
+
+
+def rank_branch_calls_helper(tree, group, coords):  # GL-C103
+    if coords[0] == 0:
+        tree = _helper_syncs(tree, group)  # helper bears the collective
+    return tree
+
+
+def rank_cond_lambda(x, axis):  # GL-C101 via lax.cond branches
+    idx = lax.axis_index(axis)
+    return lax.cond(
+        idx == 0,
+        lambda: lax.all_gather(x, axis),  # one branch gathers...
+        lambda: x,                        # ...the other doesn't
+    )
+
+
+def rank_while_collective(x, local_rank, axis):  # GL-C101 (while form)
+    while local_rank > 0:
+        x = lax.ppermute(x, axis, [(0, 1)])
+        local_rank -= 1
+    return x

@@ -1,0 +1,44 @@
+"""Seeded GL-R3xx violations — every pattern here must be FLAGGED.
+
+Mirrors the control-plane idioms of ``runtime/`` with each guard removed.
+Never imported; fed to ``analysis.control_pass.lint_source`` as text.
+"""
+
+import threading
+import time
+
+
+def k_static_claim():
+    return "budget/claim"  # helper with NO per-round discriminator
+
+
+class BadAgent:
+    def __init__(self, kv):
+        self.kv = kv
+        self.timeout = 10.0
+
+    def charge_once(self):  # GL-R301: constant key claim
+        return self.kv.add("budget/restart_claim", 1) == 1
+
+    def charge_via_helper(self):  # GL-R301: unscoped key helper
+        return self.kv.add(k_static_claim(), 1) == 1
+
+    def peer_is_alive(self, rank):  # GL-R302: remote stamp vs local clock
+        stamp = float(self.kv.get(f"hb/{rank}").decode())
+        age = time.time() - stamp  # cross-host skew corrupts this
+        return age < self.timeout
+
+    def start_worker(self):  # GL-R303: non-daemon thread
+        t = threading.Thread(target=self._run)
+        t.start()
+        return t
+
+    def _run(self):
+        pass
+
+    def _leader_tick(self):
+        self._resolve()
+
+    def _resolve(self):  # GL-R304: blocking read in a leader section
+        verdict = self.kv.get("gen/teardown")
+        return verdict

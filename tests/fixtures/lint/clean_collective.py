@@ -1,0 +1,45 @@
+"""Clean collective patterns — nothing here may be flagged.
+
+The fixture tests assert ``lint_source`` returns zero findings for this
+file: every rank-conditional is collective-free and every collective is
+reached by all ranks.
+"""
+
+from jax import lax
+
+
+def unconditional_sync(grads, axis):
+    return lax.pmean(grads, axis)  # every rank reaches this
+
+
+def rank_branch_logging_only(loss, rank):
+    if rank == 0:
+        print(f"loss={loss}")  # side effects only; no collectives
+    return loss
+
+
+def collective_then_rank_branch(grads, rank, axis):
+    grads = lax.psum(grads, axis)  # sync FIRST, uniformly
+    if rank == 0:
+        grads = grads * 1.0
+    return grads
+
+
+def rank_cond_no_collectives(x, axis):
+    idx = lax.axis_index(axis)
+    # branches diverge in VALUES, not in collective sequence — fine
+    return lax.cond(idx == 0, lambda: x * 2.0, lambda: x)
+
+
+def data_cond_collective(x, flag, axis):
+    # the predicate is data-derived, not rank-derived: all ranks take the
+    # same branch, so the gather stays collective-consistent
+    if flag:
+        x = lax.all_gather(x, axis)
+    return x
+
+
+def early_exit_before_any_collective(x, rank):
+    if rank != 0:
+        return x  # fine: no collective AFTER the divergent exit
+    return x * 2.0

@@ -1,0 +1,67 @@
+"""Clean control-plane patterns — nothing here may be flagged.
+
+The guarded twins of ``bad_control.py``: generation-scoped claims, the
+skew-free change-token watchdog idiom, daemon threads, and non-blocking
+reads inside leader sections.
+"""
+
+import threading
+import time
+
+
+def k_gen_claim(gen):
+    return f"budget/claim/{gen}"  # per-generation discriminator
+
+
+class GoodAgent:
+    def __init__(self, kv):
+        self.kv = kv
+        self.timeout = 10.0
+        self._observed = {}
+
+    def charge_once(self, gen):  # scoped literal key
+        return self.kv.add(f"budget/claim/{gen}", 1) == 1
+
+    def charge_via_helper(self, gen):  # scoped key helper
+        return self.kv.add(k_gen_claim(gen), 1) == 1
+
+    def peer_is_alive(self, rank):
+        # skew-free: the remote stamp is an opaque change token; only the
+        # LOCAL time since we saw it change is compared to the timeout
+        now = time.time()
+        raw = self.kv.try_get(f"hb/{rank}")
+        if raw is None:
+            return False
+        prev = self._observed.get(rank)
+        if prev is None or prev[0] != raw:
+            self._observed[rank] = (raw, now)
+            return True
+        return (now - prev[1]) < self.timeout
+
+    def start_worker(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        return t
+
+    def start_worker_late_daemon(self):
+        t = threading.Thread(target=self._run)
+        t.daemon = True  # set before start(): also accepted
+        t.start()
+        return t
+
+    def _run(self):
+        pass
+
+    def _leader_tick(self):
+        self._resolve()
+
+    def _resolve(self):  # non-blocking read: re-observe next tick
+        verdict = self.kv.try_get("gen/teardown")
+        if verdict is None:
+            return None
+        return verdict
+
+    def follower_wait(self):
+        # blocking get() is FINE outside leader-reachable methods —
+        # followers have no lease to lose
+        return self.kv.get("gen/launch")

@@ -233,3 +233,53 @@ def test_dp_s2dt_fused_input_matches_plain_resize(mesh8):
             np.asarray(a), np.asarray(b), atol=5e-5),
         results["s2dt"][1], results["plain"][1],
     )
+
+
+def test_shard_state_local_refuses_single_controller(mesh8):
+    """The partial-restore placement is only sound when each process owns
+    exactly its own mesh slot; a single-controller 8-device process must
+    be pushed to the full restore + shard_state path."""
+    model, tx, state = setup(use_bn=True)
+    dp = DataParallel(model, tx, mesh8, donate=False)
+    with pytest.raises(ValueError, match="one process per mesh slot"):
+        dp.shard_state_local(state, state)
+
+
+def test_shard_state_local_places_rank_blocks(mesh8, monkeypatch):
+    """Single-controller simulation of the multi-controller contract:
+    with process_count==world and one local device, restore_partial's
+    rank-local view (rep leaves global, shard0 leaves this rank's block)
+    lands on the mesh with the same specs, shapes, and dtypes the full
+    shard_state path produces — and the block itself bitwise."""
+    model = ConvNet(use_bn=True)
+    tx = optax.sgd(0.05, momentum=0.9)  # momentum: ZeRO-eligible opt state
+    state = TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx)
+    dp = DataParallel(model, tx, mesh8, donate=False, zero=True)
+    full = dp.shard_state(state)  # reference placement
+    # rank 0's restore_partial view: device 0's addressable shard of every
+    # leaf (full array for replicated leaves, the rank-0 block for sharded)
+    local = jax.tree.map(
+        lambda x: np.asarray(x.addressable_shards[0].data), full)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 8)
+    monkeypatch.setattr(jax, "local_device_count", lambda: 1)
+    placed = dp.shard_state_local(local, dp.checkpoint_template(state))
+
+    def check(p, f):
+        assert p.shape == f.shape and p.dtype == f.dtype
+        assert p.sharding == f.sharding
+        # device 0 holds rank 0's block (the only shard this simulated
+        # process is authoritative for) — bitwise what the view held
+        np.testing.assert_array_equal(
+            np.asarray(p.addressable_shards[0].data),
+            np.asarray(f.addressable_shards[0].data))
+    jax.tree.map(check, placed, full)
+
+    # a wrong-shaped block fails loudly instead of silently misplacing
+    bad = local.replace(
+        opt_state=jax.tree.map(
+            lambda x: x[:1] if x.ndim >= 1 and x.shape[0] > 1 else x,
+            local.opt_state))
+    with pytest.raises(ValueError, match="local block|replicated leaf"):
+        dp.shard_state_local(bad, dp.checkpoint_template(state))

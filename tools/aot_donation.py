@@ -1,0 +1,98 @@
+"""Chipless donation receipt: AOT-compile the DP and ZeRO train steps with
+donation on and off and read the peak-memory delta from XLA's own
+memory analysis.
+
+Donation aliases the old ``TrainState`` buffers into the new state's
+outputs; without it both generations are live across the step and the
+outputs need their own allocation on top of arguments + temps. The CPU
+backend does not implement donation (aliasing always 0 there), so this is
+strictly a TPU-topology tool — ``bench.py --metric donation`` shells out
+here and degrades gracefully off-toolchain.
+
+Single-process like every AOT tool (libtpu init + forced compiled
+kernels): do not run two at once, never import into a pytest process.
+
+Usage: python tools/aot_donation.py [--topology v5e:2x2x1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(topo, *, zero: bool, donate: bool, batch_per_rank: int = 8) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.parallel import DataParallel
+    from tpu_sandbox.train import TrainState
+
+    devices = np.array(topo.devices)
+    world = devices.size
+    mesh = Mesh(devices, ("data",))
+    model = ConvNet(use_bn=False)
+    tx = optax.sgd(1e-2, momentum=0.9)
+    state = jax.eval_shape(lambda: TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx,
+    ))
+    imgs = jax.ShapeDtypeStruct(
+        (world * batch_per_rank, 28, 28, 1), jnp.float32)
+    labs = jax.ShapeDtypeStruct((world * batch_per_rank,), jnp.int32)
+    dp = DataParallel(model, tx, mesh, zero=zero, donate=donate)
+    ma = dp.lower_step(state, imgs, labs).compile().memory_analysis()
+    out = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+    }
+    # donated outputs alias arguments; undonated outputs are a second live
+    # copy of the state on top of args + temps
+    unaliased_out = out["output_bytes"] - out["alias_bytes"]
+    out["est_peak_bytes"] = (
+        out["argument_bytes"] + out["temp_bytes"] + unaliased_out)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--topology", default="v5e:2x2x1")
+    p.add_argument("--chips-per-host", default="2,2,1")
+    p.add_argument("--batch-per-rank", type=int, default=8)
+    args = p.parse_args()
+
+    from aot_v5e import make_topology
+
+    topo = make_topology(
+        args.topology, tuple(int(x) for x in args.chips_per_host.split(",")))
+    result: dict = {
+        "metric": "donation",
+        "topology": args.topology,
+        "source": "chipless v5e AOT memory analysis "
+                  "(XLA estimates, not measurements)",
+    }
+    for label, zero in (("dp", False), ("zero", True)):
+        on = measure(topo, zero=zero, donate=True,
+                     batch_per_rank=args.batch_per_rank)
+        off = measure(topo, zero=zero, donate=False,
+                      batch_per_rank=args.batch_per_rank)
+        result[label] = {
+            "donate_on": on,
+            "donate_off": off,
+            "peak_delta_bytes": off["est_peak_bytes"] - on["est_peak_bytes"],
+            "donation_verified": on["alias_bytes"] > 0,
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Baseline suppression for graftlint findings.
+
+``analysis/baseline.toml`` holds the accepted findings so the gate starts
+green and *ratchets*: new findings fail, removing code removes its
+suppression pressure, and ``--update-baseline`` re-emits the file.
+
+Format — a TOML subset (the file stays valid TOML for external tooling),
+parsed here with a ~40-line reader because the pinned interpreter is
+Python 3.10 (no ``tomllib``) and the container can't grow dependencies:
+
+    [[suppress]]
+    rule = "GL-R304"
+    file = "tpu_sandbox/runtime/host_agent.py"
+    match = "kv.get(k_teardown"
+    reason = "why this is accepted"
+
+``match`` is a substring of the finding's source snippet, so suppressions
+survive line-number churn; ``file`` is the exact repo-relative path. An
+entry with no ``match`` suppresses every finding of that rule in that
+file. Unused entries are reported so stale suppressions get deleted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_sandbox.analysis.findings import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rule: str
+    file: str
+    match: str = ""
+    reason: str = ""
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def _parse_value(raw: str, lineno: int) -> str:
+    raw = raw.strip()
+    if len(raw) >= 2 and raw[0] == '"' and raw[-1] == '"':
+        body = raw[1:-1]
+        out = []
+        i = 0
+        while i < len(body):
+            c = body[i]
+            if c == "\\" and i + 1 < len(body):
+                nxt = body[i + 1]
+                out.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\"}
+                           .get(nxt, nxt))
+                i += 2
+            else:
+                out.append(c)
+                i += 1
+        return "".join(out)
+    raise BaselineError(
+        f"baseline line {lineno}: expected a double-quoted string, got "
+        f"{raw!r}"
+    )
+
+
+def parse_baseline(text: str) -> list[Suppression]:
+    entries: list[dict[str, str]] = []
+    current: dict[str, str] | None = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped == "[[suppress]]":
+            current = {}
+            entries.append(current)
+            continue
+        if stripped.startswith("["):
+            raise BaselineError(
+                f"baseline line {lineno}: only [[suppress]] tables are "
+                f"supported, got {stripped!r}"
+            )
+        if "=" not in stripped:
+            raise BaselineError(
+                f"baseline line {lineno}: expected 'key = \"value\"'"
+            )
+        if current is None:
+            raise BaselineError(
+                f"baseline line {lineno}: key outside a [[suppress]] table"
+            )
+        key, _, raw = stripped.partition("=")
+        key = key.strip()
+        if key not in ("rule", "file", "match", "reason"):
+            raise BaselineError(
+                f"baseline line {lineno}: unknown key {key!r}"
+            )
+        current[key] = _parse_value(raw, lineno)
+    out = []
+    for i, e in enumerate(entries):
+        if "rule" not in e or "file" not in e:
+            raise BaselineError(
+                f"baseline entry #{i + 1} is missing 'rule' or 'file'"
+            )
+        out.append(Suppression(
+            rule=e["rule"], file=e["file"],
+            match=e.get("match", ""), reason=e.get("reason", ""),
+        ))
+    return out
+
+
+def load_baseline(path: str) -> list[Suppression]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return parse_baseline(f.read())
+    except FileNotFoundError:
+        return []
+
+
+def _matches(s: Suppression, f: Finding) -> bool:
+    if s.rule != f.rule or s.file != f.file:
+        return False
+    if s.match:
+        return s.match in f.snippet or s.match in f.message
+    return True
+
+
+def apply_baseline(
+    findings: list[Finding], suppressions: list[Suppression],
+) -> tuple[list[Finding], list[Finding], list[Suppression]]:
+    """-> (kept, suppressed, unused suppressions)."""
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    used = [False] * len(suppressions)
+    for f in findings:
+        hit = False
+        for i, s in enumerate(suppressions):
+            if _matches(s, f):
+                used[i] = True
+                hit = True
+        (suppressed if hit else kept).append(f)
+    unused = [s for s, u in zip(suppressions, used) if not u]
+    return kept, suppressed, unused
+
+
+def _toml_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def render_baseline(findings: list[Finding], *, reason: str = "") -> str:
+    """Emit a baseline file suppressing exactly ``findings``."""
+    lines = [
+        "# graftlint accepted-findings baseline.",
+        "# Each [[suppress]] entry silences matching findings; 'match' is a",
+        "# substring of the offending source line so entries survive line",
+        "# churn. Regenerate with: python tools/graftlint.py "
+        "--update-baseline",
+        "",
+    ]
+    seen: set[tuple[str, str, str]] = set()
+    for f in findings:
+        match = f.snippet[:80] if f.snippet else ""
+        key = (f.rule, f.file, match)
+        if key in seen:
+            continue
+        seen.add(key)
+        lines.append("[[suppress]]")
+        lines.append(f"rule = {_toml_str(f.rule)}")
+        lines.append(f"file = {_toml_str(f.file)}")
+        if match:
+            lines.append(f"match = {_toml_str(match)}")
+        lines.append(f"reason = {_toml_str(reason or 'TRIAGE: ' + f.message)}")
+        lines.append("")
+    return "\n".join(lines)

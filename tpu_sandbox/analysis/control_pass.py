@@ -1,0 +1,426 @@
+"""Pass 3: control-plane lint over ``runtime/`` (AST).
+
+Four rules distilled from this repo's own elastic-runtime incident
+history:
+
+- **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
+  generation/term/round discriminator. An unscoped claim-once key stays
+  claimed forever: budgets double-charge on the first race and then
+  never charge again. Key helpers (module functions / methods that
+  return f-strings, e.g. ``k_charge_claim(gen)``) are resolved so a
+  scoped helper call counts as scoped.
+- **GL-R302** — arithmetic mixing ``time.time()`` with a value read from
+  the KV store (a remote wall-clock stamp). Cross-host skew makes that
+  difference meaningless; the watchdog idiom is to track when the local
+  observer last saw the stamp *change* and bound that local age.
+- **GL-R303** — ``threading.Thread(...)`` without ``daemon=True`` (and
+  no ``x.daemon = True`` before ``x.start()`` in the same function).
+  Non-daemon threads outlive crashed owners and trip the conftest
+  ``_no_resource_leaks`` check.
+- **GL-R304** — blocking ``kv.get(...)`` reachable from a leader-action
+  method (``_leader*`` roots, intra-class call graph). A blocking read
+  can park the leader past its lease TTL; leader ticks must use
+  ``try_get`` and re-observe next tick.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpu_sandbox.analysis.findings import Finding, make_finding
+
+#: identifiers that count as a per-round discriminator inside a claim key
+SCOPE_TOKENS = frozenset({
+    "gen", "generation", "term", "index", "idx", "step", "epoch",
+    "attempt", "round", "fault", "token", "nonce", "seq",
+})
+
+#: attribute names that mark a receiver as "the KV client"
+KV_RECEIVERS = frozenset({"kv", "client", "store", "_kv", "_client", "_store"})
+
+
+def _final_attr(node: ast.AST) -> str | None:
+    """``self.kv`` -> 'kv', ``agent.client`` -> 'client', ``kv`` -> 'kv'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_kv_receiver(node: ast.AST) -> bool:
+    name = _final_attr(node)
+    return name is not None and name in KV_RECEIVERS
+
+
+def _fstring_idents(node: ast.JoinedStr) -> set[str]:
+    idents: set[str] = set()
+    for part in node.values:
+        if isinstance(part, ast.FormattedValue):
+            for sub in ast.walk(part.value):
+                if isinstance(sub, ast.Name):
+                    idents.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    idents.add(sub.attr)
+    return idents
+
+
+def _has_scope(idents: set[str]) -> bool:
+    return any(
+        tok in SCOPE_TOKENS or any(tok.startswith(s) or tok.endswith(s)
+                                   for s in ("gen", "term", "idx"))
+        for tok in {i.lower() for i in idents}
+    )
+
+
+class _KeyHelperIndex:
+    """Module functions / methods whose body ``return``s a string key.
+
+    Maps bare helper name -> (set of identifiers interpolated into the
+    returned f-string, unioned with the helper's own parameter names when
+    they feed the f-string). A helper returning a constant string maps to
+    an empty set — calling it for a claim is as unscoped as the literal.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.scopes: dict[str, set[str] | None] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            returned = self._returned_key_idents(node)
+            if returned is not None:
+                self.scopes[node.name] = returned
+
+    @staticmethod
+    def _returned_key_idents(fn: ast.AST) -> set[str] | None:
+        """None if the function doesn't look like a key helper; else the
+        identifier set interpolated into its returned string."""
+        idents: set[str] | None = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if isinstance(node.value, ast.JoinedStr):
+                    found = _fstring_idents(node.value)
+                elif isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    found = set()
+                else:
+                    continue
+                idents = found if idents is None else (idents | found)
+        return idents
+
+
+class _FnLinter:
+    def __init__(self, path: str, lines: list[str], helpers: _KeyHelperIndex,
+                 findings: list[Finding]):
+        self.path = path
+        self.lines = lines
+        self.helpers = helpers
+        self.findings = findings
+
+    def _snippet(self, node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return self.lines[ln - 1].strip() if 0 < ln <= len(self.lines) else ""
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(make_finding(
+            rule, self.path, getattr(node, "lineno", 0), message,
+            snippet=self._snippet(node),
+        ))
+
+    # -- GL-R301 -------------------------------------------------------------
+
+    def _key_scope(self, key: ast.AST) -> bool | None:
+        """True = scoped, False = provably unscoped, None = unknown."""
+        if isinstance(key, ast.JoinedStr):
+            return _has_scope(_fstring_idents(key))
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return False
+        if isinstance(key, ast.Call):
+            name = _final_attr(key.func)
+            if name in self.helpers.scopes:
+                helper_idents = self.helpers.scopes[name]
+                # identifiers interpolated by the helper + what the call
+                # site passes in (k_claim(gen) scopes even if the helper
+                # names its parameter differently)
+                site_idents: set[str] = set()
+                for arg in key.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            site_idents.add(sub.id)
+                        elif isinstance(sub, ast.Attribute):
+                            site_idents.add(sub.attr)
+                return _has_scope(helper_idents | site_idents)
+            return None
+        if isinstance(key, ast.BinOp):  # "prefix/" + str(gen) style
+            idents = {
+                sub.id for sub in ast.walk(key) if isinstance(sub, ast.Name)
+            } | {
+                sub.attr for sub in ast.walk(key)
+                if isinstance(sub, ast.Attribute)
+            }
+            return _has_scope(idents)
+        return None  # bare Name / subscript: key built elsewhere — skip
+
+    def _check_claim(self, node: ast.Compare) -> None:
+        """``X.add(key, ..) == 1`` / ``!= 1`` with an unscoped key."""
+        sides = [node.left] + list(node.comparators)
+        call = next(
+            (s for s in sides
+             if isinstance(s, ast.Call)
+             and isinstance(s.func, ast.Attribute)
+             and s.func.attr == "add"
+             and _is_kv_receiver(s.func.value)),
+            None,
+        )
+        if call is None or not call.args:
+            return
+        one = any(
+            isinstance(s, ast.Constant) and s.value == 1
+            for s in sides if s is not call
+        )
+        if not one or not isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            return
+        if self._key_scope(call.args[0]) is False:
+            self._emit(
+                "GL-R301", node,
+                "add()-wins claim key carries no generation/term scope — "
+                "it stays claimed across rounds",
+            )
+
+    # -- GL-R302 -------------------------------------------------------------
+
+    @staticmethod
+    def _is_time_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("time", "monotonic")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        )
+
+    def _taint_kv_reads(self, fn: ast.AST) -> set[str]:
+        """Names assigned (transitively through float()/decode()/…) from a
+        kv-ish ``.get``/``.try_get`` in this function."""
+        tainted: set[str] = set()
+
+        def expr_tainted(expr: ast.AST) -> bool:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in ("get", "try_get") \
+                        and _is_kv_receiver(sub.func.value):
+                    return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                    # only plain-name (or tuple-of-name) targets taint:
+                    # `obj[k] = (stamp, now)` must not taint `obj` or `k`
+                    for tgt in node.targets:
+                        names = [tgt] if isinstance(tgt, ast.Name) else (
+                            tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                            else []
+                        )
+                        for sub in names:
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id not in tainted:
+                                tainted.add(sub.id)
+                                changed = True
+        return tainted
+
+    def _check_stamp_math(self, fn: ast.AST) -> None:
+        tainted = self._taint_kv_reads(fn)
+
+        def side_is_now(expr: ast.AST) -> bool:
+            if self._is_time_call(expr):
+                return True
+            return isinstance(expr, ast.Name) and expr.id in ("now", "t_now")
+
+        def side_is_stamp(expr: ast.AST) -> bool:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in ("get", "try_get") \
+                        and _is_kv_receiver(sub.func.value):
+                    return True
+            return False
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                pairs = ((node.left, node.right), (node.right, node.left))
+                if any(side_is_now(a) and side_is_stamp(b)
+                       for a, b in pairs):
+                    self._emit(
+                        "GL-R302", node,
+                        "local clock minus a KV-read stamp: cross-host "
+                        "skew corrupts this age",
+                    )
+
+    # -- GL-R303 -------------------------------------------------------------
+
+    def _check_threads(self, fn: ast.AST) -> None:
+        daemon_set: set[str] = set()   # names with `.daemon = True` later
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and node.targets[0].attr == "daemon" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True:
+                tgt = node.targets[0].value
+                name = _final_attr(tgt)
+                if name:
+                    daemon_set.add(name)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and _final_attr(node.func) == "Thread"):
+                continue
+            daemon_kw = next(
+                (kw for kw in node.keywords if kw.arg == "daemon"), None,
+            )
+            if daemon_kw is not None:
+                if not (isinstance(daemon_kw.value, ast.Constant)
+                        and daemon_kw.value.value is True):
+                    self._emit(
+                        "GL-R303", node,
+                        "Thread created with daemon != True",
+                    )
+                continue
+            # no daemon kwarg: accept `x = Thread(...)` + `x.daemon = True`
+            assigned = self._assigned_name(fn, node)
+            if assigned is not None and assigned in daemon_set:
+                continue
+            self._emit(
+                "GL-R303", node,
+                "Thread created without daemon=True (leaks past the "
+                "conftest check, outlives crashed owners)",
+            )
+
+    @staticmethod
+    def _assigned_name(fn: ast.AST, call: ast.Call) -> str | None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is call:
+                name = _final_attr(node.targets[0])
+                if name:
+                    return name
+        return None
+
+    # -- GL-R304 (per-class, run separately) ---------------------------------
+
+    def run_common(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Compare):
+                self._check_claim(node)
+        self._check_stamp_math(fn)
+        self._check_threads(fn)
+
+
+def _leader_reachable(cls: ast.ClassDef) -> set[str]:
+    """Method names reachable from ``_leader*`` roots via ``self._x()``."""
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    calls: dict[str, set[str]] = {}
+    for name, fn in methods.items():
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self" \
+                    and node.func.attr in methods:
+                out.add(node.func.attr)
+        calls[name] = out
+    reachable = {n for n in methods if n.startswith("_leader")}
+    frontier = list(reachable)
+    while frontier:
+        cur = frontier.pop()
+        for callee in calls.get(cur, ()):
+            if callee not in reachable:
+                reachable.add(callee)
+                frontier.append(callee)
+    return reachable
+
+
+def _check_leader_blocking_reads(
+    cls: ast.ClassDef, path: str, lines: list[str],
+    findings: list[Finding],
+) -> None:
+    reachable = _leader_reachable(cls)
+    if not reachable:
+        return
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in reachable:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "get" \
+                    and _is_kv_receiver(sub.func.value):
+                ln = getattr(sub, "lineno", 0)
+                snippet = lines[ln - 1].strip() \
+                    if 0 < ln <= len(lines) else ""
+                findings.append(make_finding(
+                    "GL-R304", path, ln,
+                    f"blocking kv.get() inside leader-reachable "
+                    f"'{cls.name}.{node.name}' can outlast the lease TTL",
+                    snippet=snippet,
+                ))
+
+
+def lint_source(source: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [make_finding(
+            "GL-R303", path, e.lineno or 0,
+            f"unparseable module skipped ({e.msg})",
+            hint="fix the syntax error so the pass can see this file",
+        )]
+    lines = source.splitlines()
+    helpers = _KeyHelperIndex(tree)
+    findings: list[Finding] = []
+    linter = _FnLinter(path, lines, helpers, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            linter.run_common(node)
+        elif isinstance(node, ast.ClassDef):
+            _check_leader_blocking_reads(node, path, lines, findings)
+    return findings
+
+
+def run_control_pass(
+    root: str, *, paths: list[str] | None = None,
+) -> list[Finding]:
+    """Lint ``runtime/`` (or explicit ``paths``); labels are root-relative."""
+    if paths is None:
+        runtime = os.path.join(root, "tpu_sandbox", "runtime")
+        paths = []
+        if os.path.isdir(runtime):
+            for fn in sorted(os.listdir(runtime)):
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(runtime, fn))
+    findings: list[Finding] = []
+    for p in paths:
+        rel = os.path.relpath(p, root)
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        findings.extend(lint_source(src, rel))
+    return findings

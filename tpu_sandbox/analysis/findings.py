@@ -1,0 +1,120 @@
+"""Shared finding model + rule catalog for the graftlint passes.
+
+Every pass emits :class:`Finding` rows — file:line, a stable rule id, a
+one-line message, and a fix hint — so the CLI, the tier-1 gate, and the
+baseline suppressor all speak one format. Rule ids are grouped by pass:
+
+- ``GL-C1xx``  Pass 1: collective consistency (AST, SPMD-divergence class)
+- ``GL-H2xx``  Pass 2: jaxpr / chipless AOT HLO step lint
+- ``GL-R3xx``  Pass 3: control-plane lint (AST over runtime/)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``snippet`` is the stripped source line (or a short
+    machine summary for compile-level findings) — the baseline matches on
+    it so suppressions survive line-number churn."""
+
+    rule: str
+    file: str        # repo-relative path, or "<step:NAME>" for compile lint
+    line: int        # 1-based; 0 for compile-level findings
+    message: str
+    hint: str = ""
+    snippet: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        out = f"{loc}: {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+#: rule id -> (title, default fix hint)
+RULES: dict[str, tuple[str, str]] = {
+    # -- Pass 1: collective consistency --------------------------------------
+    "GL-C101": (
+        "collective under a rank-conditioned branch",
+        "hoist the collective out of the rank-conditional (all ranks must "
+        "reach every collective in the same order) or guard the whole "
+        "function, not the call",
+    ),
+    "GL-C102": (
+        "collective after a rank-conditioned early exit",
+        "a rank that returns/raises early never reaches the collective the "
+        "others are blocked in; make the exit unconditional or move it "
+        "after the last collective",
+    ),
+    "GL-C103": (
+        "collective-bearing call under a rank-conditioned branch",
+        "the callee's collective sequence diverges across ranks through "
+        "this call site; hoist the call or strip the callee's collectives",
+    ),
+    # -- Pass 2: step-function jaxpr / HLO lint ------------------------------
+    "GL-H201": (
+        "missing input donation on TrainState buffers",
+        "pass donate=True (donate_argnums=(0,)) so XLA aliases the old "
+        "state's buffers into the new state instead of holding both live",
+    ),
+    "GL-H202": (
+        "bf16->fp32 upcast inside the step",
+        "a large convert_element_type to f32 doubles that buffer's HBM "
+        "footprint; keep the tensor in bf16 or upcast per-block",
+    ),
+    "GL-H203": (
+        "host transfer inside the step",
+        "callbacks/infeed/outfeed serialize the step on host round-trips; "
+        "move the host work outside the jit or behind io_callback batching",
+    ),
+    "GL-H204": (
+        "grad-sync collectives all scheduled after the last backward op",
+        "overlap_grad_sync is on but XLA issued no all-reduce before the "
+        "last backward compute: nothing can hide under compute — check "
+        "bucket_mb and the latency-hiding compiler flags",
+    ),
+    "GL-H205": (
+        "int8 block padding waste above threshold",
+        "block/axis alignment padding dominates the int8 wire payload; "
+        "lower CompressedAllReduce.block or fuse small leaves into buckets",
+    ),
+    # -- Pass 3: control-plane lint ------------------------------------------
+    "GL-R301": (
+        "KV add() claim without generation/term scoping",
+        "an unscoped add()-wins claim stays claimed across generations "
+        "(double-charge / never-again-charge); scope the key with the "
+        "generation, term, or another per-round discriminator",
+    ),
+    "GL-R302": (
+        "heartbeat stamp compared against the local clock",
+        "cross-host clock skew makes wall-stamp arithmetic read as death "
+        "(or mask one); track when the observer last saw the stamp CHANGE "
+        "and bound that local age instead (see runtime/watchdog.Watchdog)",
+    ),
+    "GL-R303": (
+        "thread started without daemon=True",
+        "non-daemon threads trip the conftest leak check and outlive "
+        "crashed owners; pass daemon=True (or set .daemon before start())",
+    ),
+    "GL-R304": (
+        "blocking KV read inside a leader-action critical section",
+        "a blocking get() can park the leader past its lease TTL (a peer "
+        "takes over while this one still thinks it leads); use try_get() "
+        "and re-observe next tick",
+    ),
+}
+
+
+def make_finding(rule: str, file: str, line: int, message: str,
+                 snippet: str = "", hint: str | None = None) -> Finding:
+    if rule not in RULES:
+        raise ValueError(f"unknown rule id {rule!r}")
+    return Finding(
+        rule=rule, file=file, line=line, message=message,
+        hint=RULES[rule][1] if hint is None else hint,
+        snippet=snippet,
+    )

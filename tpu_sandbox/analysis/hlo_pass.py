@@ -1,0 +1,395 @@
+"""Pass 2: step-function jaxpr + chipless AOT HLO lint.
+
+Two layers, split so the cheap one is always available:
+
+- **Pure functions** (``lint_jaxpr``, ``lint_hlo_text``,
+  ``lint_schedule``, ``lint_int8_padding``) take already-built artifacts
+  and emit findings. They import nothing heavy — the fixture tests drive
+  them directly.
+- **The driver** (:func:`run_hlo_pass`) builds the real engines chipless
+  and feeds them through: it traces ``DataParallel`` (plain + ZeRO),
+  ``PjitEngine``, and ``PipelineParallel`` steps to jaxprs on CPU
+  devices, then AOT-compiles the DP/ZeRO steps against a multi-chip v5e
+  topology (``tools/aot_v5e.make_topology``) to verify input donation
+  from XLA's own ``memory_analysis`` and to check the overlapped
+  grad-sync schedule via ``tools/hlo_schedule.schedule_report``.
+
+The driver mutates process env (``make_topology`` forces compiled
+Pallas kernels) — run it in a dedicated process (the ``graftlint`` CLI),
+never inside a long-lived pytest process. AOT tools are single-process:
+do not run two at once.
+
+Donation is checked on the AOT TPU path only: the CPU backend does not
+implement buffer donation (aliasing always reports 0 there), so a CPU
+"check" would flag every engine. ``memory_analysis().alias_size_in_bytes``
+vs ``output_size_in_bytes`` is the signal — parsing the
+``input_output_alias={...}`` header breaks on nested braces.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tpu_sandbox.analysis.findings import Finding, make_finding
+
+#: convert_element_type upcasts smaller than this many elements are noise
+#: (scalar losses, iteration counters); above it the fp32 copy of a bf16
+#: tensor is a real HBM cost.
+UPCAST_MIN_ELEMENTS = 4096
+
+#: jaxpr primitives that round-trip through the host inside the step
+HOST_TRANSFER_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "infeed", "outfeed", "host_callback_call",
+})
+
+#: int8 wire overhead (scales + alignment padding) above this fraction of
+#: the all-in total means padding dominates the compression win.
+INT8_OVERHEAD_THRESHOLD = 0.25
+
+#: donated-aliasing below this fraction of output bytes counts as missing
+#: (the non-aliasable remainder — the scalar loss — is well under 1%).
+DONATION_MIN_FRACTION = 0.5
+
+
+# --------------------------------------------------------------------------
+# pure lints (no jax import; fixture tests call these directly)
+# --------------------------------------------------------------------------
+
+def _iter_eqns(jaxpr):
+    """Yield every eqn in a (Closed)Jaxpr, recursing through call/scan/
+    cond/shard_map sub-jaxprs found in eqn params."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in inner.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            stack = [val]
+            while stack:
+                v = stack.pop()
+                if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+                    yield from _iter_eqns(v)
+                elif isinstance(v, (list, tuple)):
+                    stack.extend(v)
+
+
+def lint_jaxpr(jaxpr, label: str) -> list[Finding]:
+    """Lint one traced step jaxpr. ``label`` names the step (e.g. 'dp');
+    findings carry ``file="<step:label>"`` and line 0."""
+    file = f"<step:{label}>"
+    findings: list[Finding] = []
+    import numpy as np  # ubiquitous; fine even in the "pure" layer
+
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            new = eqn.params.get("new_dtype")
+            if new is None or "float32" not in str(new):
+                continue
+            aval = eqn.invars[0].aval
+            src = str(getattr(aval, "dtype", ""))
+            n = int(np.prod(getattr(aval, "shape", ()) or (1,)))
+            if src == "bfloat16" and n >= UPCAST_MIN_ELEMENTS:
+                findings.append(make_finding(
+                    "GL-H202", file, 0,
+                    f"bf16->f32 convert of {n} elements "
+                    f"(shape {tuple(aval.shape)}) inside the step",
+                    snippet=f"convert_element_type {tuple(aval.shape)} "
+                            f"bf16->f32",
+                ))
+        elif name in HOST_TRANSFER_PRIMITIVES:
+            findings.append(make_finding(
+                "GL-H203", file, 0,
+                f"host-transfer primitive '{name}' inside the step",
+                snippet=f"primitive {name}",
+            ))
+    return findings
+
+
+def lint_hlo_text(hlo_text: str, label: str) -> list[Finding]:
+    """Host-transfer + large-upcast scan over optimized HLO text (the
+    post-fusion complement of the jaxpr walk)."""
+    import re
+
+    file = f"<step:{label}>"
+    findings: list[Finding] = []
+    host_marks = ("SendToHost", "RecvFromHost", "custom_call_target=\"tpu_"
+                  "host", "infeed(", "outfeed(")
+    upcast = re.compile(r"=\s*f32\[([\d,]*)\][^ ]*\s+convert\(\s*%?\S*bf16")
+    for i, line in enumerate(hlo_text.splitlines(), start=1):
+        if any(m in line for m in host_marks):
+            findings.append(make_finding(
+                "GL-H203", file, 0,
+                f"host transfer op in optimized HLO (module line {i})",
+                snippet=line.strip()[:120],
+            ))
+            continue
+        m = upcast.search(line)
+        if m:
+            dims = [int(d) for d in m.group(1).split(",") if d]
+            n = 1
+            for d in dims:
+                n *= d
+            if n >= UPCAST_MIN_ELEMENTS:
+                findings.append(make_finding(
+                    "GL-H202", file, 0,
+                    f"bf16->f32 convert of {n} elements survived into "
+                    f"optimized HLO (module line {i})",
+                    snippet=line.strip()[:120],
+                ))
+    return findings
+
+
+def lint_donation(label: str, *, donate_requested: bool, alias_bytes: int,
+                  output_bytes: int) -> tuple[list[Finding], dict]:
+    """GL-H201 verdict from XLA's memory-analysis numbers. Returns
+    ``(findings, report_entry)``; the driver feeds real compiles through
+    here, the fixture tests feed synthetic numbers."""
+    frac = alias_bytes / output_bytes if output_bytes else 0.0
+    entry = {
+        "donate_requested": donate_requested,
+        "alias_bytes": int(alias_bytes),
+        "output_bytes": int(output_bytes),
+        "alias_fraction": round(frac, 4),
+        "donation": "verified" if frac >= DONATION_MIN_FRACTION
+        else "missing",
+    }
+    if frac < DONATION_MIN_FRACTION:
+        return [make_finding(
+            "GL-H201", f"<step:{label}>", 0,
+            f"step compiled with donate={donate_requested} but XLA aliased "
+            f"only {int(alias_bytes)}/{int(output_bytes)} output bytes — "
+            "TrainState buffers are not donated",
+            snippet=f"alias_fraction={frac:.4f}",
+        )], entry
+    return [], entry
+
+
+def lint_schedule(report: dict, label: str, *, overlap: bool) -> list[Finding]:
+    """GL-H204 from a ``tools/hlo_schedule.schedule_report`` dict: overlap
+    was requested but every grad all-reduce issues after the last backward
+    compute op — nothing can hide under compute."""
+    if not overlap:
+        return []
+    issues = report.get("all_reduce_issues_before_last_bwd_compute", 0)
+    n_coll = report.get("collective_count", 0)
+    if n_coll and not issues:
+        return [make_finding(
+            "GL-H204", f"<step:{label}>", 0,
+            f"overlap_grad_sync requested but 0 of {n_coll} collectives "
+            "issue before the last backward compute op",
+            snippet=f"all_reduce_issues_before_last_bwd_compute=0 "
+                    f"collective_count={n_coll}",
+        )]
+    return []
+
+
+def lint_int8_padding(leaf_sizes, size: int, *, block: int = 256,
+                      label: str = "dp",
+                      threshold: float = INT8_OVERHEAD_THRESHOLD,
+                      compress=None) -> tuple[list[Finding], dict]:
+    """GL-H205 from the analytic wire model: fraction of the int8 all-in
+    wire bytes that is scales + block/axis alignment padding. Returns
+    ``(findings, wire_report)``."""
+    if compress is None:
+        from tpu_sandbox.parallel.collectives import CompressedAllReduce
+        compress = CompressedAllReduce(mode="int8", block=block)
+    wire = compress.wire_bytes(list(leaf_sizes), size)
+    frac = wire["overhead"] / wire["total"] if wire["total"] else 0.0
+    wire = dict(wire, overhead_fraction=round(frac, 4), world=size,
+                block=block)
+    if frac > threshold:
+        return [make_finding(
+            "GL-H205", f"<step:{label}>", 0,
+            f"int8 wire overhead (scales+padding) is {frac:.0%} of total "
+            f"({wire['overhead']}/{wire['total']} bytes) at world={size}, "
+            f"block={block}",
+            snippet=f"int8 overhead_fraction={frac:.4f}",
+        )], wire
+    return [], wire
+
+
+# --------------------------------------------------------------------------
+# driver: build the real engines chipless and lint them
+# --------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _tools_on_path() -> None:
+    tools = os.path.join(_repo_root(), "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+
+
+def _trace_targets(steps) -> tuple[list[Finding], dict]:
+    """Jaxpr-lint the requested engines on CPU devices (needs 8; the CLI
+    sets XLA_FLAGS=--xla_force_host_platform_device_count=8 pre-import)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.train import TrainState
+
+    findings: list[Finding] = []
+    report: dict = {}
+    devices = np.array(jax.devices()[:8])
+    if devices.size < 8:
+        report["jaxpr"] = {"status": "skipped",
+                           "reason": f"only {devices.size} devices"}
+        return findings, report
+
+    model = ConvNet(use_bn=False)
+    tx = optax.sgd(1e-2, momentum=0.9)
+    state = jax.eval_shape(lambda: TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx,
+    ))
+    imgs = jax.ShapeDtypeStruct((64, 28, 28, 1), jnp.float32)
+    labs = jax.ShapeDtypeStruct((64,), jnp.int32)
+    mesh = Mesh(devices, ("data",))
+
+    def trace(label, fn, *args):
+        try:
+            jaxpr = fn.trace(*args).jaxpr
+        except Exception as e:
+            report[label] = {"status": "trace-failed", "error": str(e)[:200]}
+            return
+        fnd = lint_jaxpr(jaxpr, label)
+        findings.extend(fnd)
+        report[label] = {"status": "traced", "findings": len(fnd)}
+
+    from tpu_sandbox.parallel import DataParallel, PjitEngine
+
+    if "dp" in steps:
+        dp = DataParallel(model, tx, mesh)
+        trace("dp", dp._compile_for(state), state, imgs, labs)
+    if "zero" in steps:
+        dpz = DataParallel(model, tx, mesh, zero=True)
+        trace("zero", dpz._compile_for(state), state, imgs, labs)
+    if "pjit" in steps:
+        eng = PjitEngine(model, tx, mesh)
+        trace("pjit", eng._build(state), state, imgs, labs)
+    if "pipeline" in steps:
+        from tpu_sandbox.models.transformer import TransformerConfig
+        from tpu_sandbox.parallel import PipelineParallel
+
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=4, d_ff=64, max_len=64)
+        mesh_pp = Mesh(devices.reshape(2, 4), ("data", "pipe"))
+        pp = PipelineParallel(cfg, tx, mesh_pp, microbatches=2)
+        pstate = jax.eval_shape(
+            pp.init_state, jax.random.key(0),
+            jnp.zeros((4, 64), jnp.int32),
+        )
+        toks = jax.ShapeDtypeStruct((4, 64), jnp.int32)
+        trace("pipeline", pp._compile_for(pstate), pstate, toks, toks)
+    return findings, report
+
+
+def _aot_targets(steps, *, topology: str, chips, overlap_check: bool,
+                 int8_check: bool) -> tuple[list[Finding], dict]:
+    """Donation + schedule + padding lint against a chipless v5e topology."""
+    _tools_on_path()
+    from aot_v5e import make_topology
+    from hlo_schedule import build_overlapped_hlo, schedule_report
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from tpu_sandbox.models import ConvNet
+    from tpu_sandbox.parallel import DataParallel
+    from tpu_sandbox.train import TrainState
+
+    findings: list[Finding] = []
+    report: dict = {}
+    topo = make_topology(topology, tuple(chips))
+    devices = np.array(topo.devices)
+    world = devices.size
+    mesh = Mesh(devices, ("data",))
+
+    model = ConvNet(use_bn=False)
+    tx = optax.sgd(1e-2, momentum=0.9)
+    state = jax.eval_shape(lambda: TrainState.create(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)), tx,
+    ))
+    imgs = jax.ShapeDtypeStruct((world * 8, 28, 28, 1), jnp.float32)
+    labs = jax.ShapeDtypeStruct((world * 8,), jnp.int32)
+
+    def check_donation(label: str, engine) -> None:
+        compiled = engine.lower_step(state, imgs, labs).compile()
+        ma = compiled.memory_analysis()
+        alias = getattr(ma, "alias_size_in_bytes", None)
+        out = getattr(ma, "output_size_in_bytes", 0)
+        if alias is None:
+            report[label] = {"donation": "unknown",
+                             "reason": "no alias_size_in_bytes"}
+            return
+        fnd, report[label] = lint_donation(
+            label, donate_requested=engine._donate,
+            alias_bytes=int(alias), output_bytes=int(out),
+        )
+        findings.extend(fnd)
+        findings.extend(lint_hlo_text(compiled.as_text(), label))
+
+    if "dp" in steps:
+        check_donation("dp", DataParallel(model, tx, mesh))
+    if "zero" in steps:
+        check_donation("zero", DataParallel(model, tx, mesh, zero=True))
+
+    if overlap_check:
+        text = build_overlapped_hlo(devices, bucket_mb=0.02, overlap=True)
+        sched = schedule_report(text)
+        findings.extend(lint_schedule(sched, "dp-overlap", overlap=True))
+        report["overlap_schedule"] = {
+            "collective_count": sched["collective_count"],
+            "issues_before_last_bwd":
+                sched["all_reduce_issues_before_last_bwd_compute"],
+            "exposed_comm_fraction": sched["exposed_comm_fraction"],
+        }
+
+    if int8_check:
+        leaf_sizes = [
+            int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+        ]
+        fnd, wire = lint_int8_padding(leaf_sizes, world, label="dp")
+        findings.extend(fnd)
+        report["int8_wire"] = wire
+    return findings, report
+
+
+def run_hlo_pass(
+    *,
+    steps=("dp", "zero", "pjit", "pipeline"),
+    aot: bool = True,
+    topology: str = "v5e:2x2x1",
+    chips=(2, 2, 1),
+    overlap_check: bool = True,
+    int8_check: bool = True,
+) -> tuple[list[Finding], dict]:
+    """Full Pass 2. Returns ``(findings, report)``; ``report`` carries the
+    per-step donation/trace status the acceptance gate prints. With
+    ``aot=False`` only the CPU jaxpr layer runs (donation is then
+    'skipped', never 'missing' — CPU can't witness aliasing)."""
+    findings, report = _trace_targets(steps)
+    if aot:
+        try:
+            aot_findings, aot_report = _aot_targets(
+                steps, topology=topology, chips=chips,
+                overlap_check=overlap_check, int8_check=int8_check,
+            )
+            findings.extend(aot_findings)
+            report["aot"] = aot_report
+        except Exception as e:
+            report["aot"] = {"status": "skipped",
+                             "reason": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        report["aot"] = {"status": "skipped", "reason": "aot disabled"}
+    return findings, report

@@ -261,11 +261,20 @@ class CollectiveGroup:
         x = self.put(jnp.ones((n, elems // n), jnp.float32))
         fn = self._all_reduce_fns["mean"]
 
+        # k collectives chained INSIDE one program per timed call: a
+        # Python-level launch loop dispatches k separate multi-device
+        # programs back-to-back, and XLA:CPU's per-launch participant
+        # rendezvous can deadlock when the host has fewer cores than
+        # devices (~30% of runs on a 1-core/8-device box). In-program
+        # collectives are cooperative — the same shape as a train step —
+        # and fori_loop keeps every iteration data-dependent, so no
+        # iteration can be elided.
+        @partial(jax.jit, static_argnums=0)
+        def run_k(k, v):
+            return jax.lax.fori_loop(0, k, lambda _, o: fn(o), v)
+
         def run(k):
-            out = x
-            for _ in range(k):
-                out = fn(out)
-            return out
+            return run_k(k, x)
 
         timing = measure_per_step(run, iters)
         if timing["sec_per_step"] <= 0:

@@ -245,6 +245,77 @@ class DataParallel:
 
         return jax.tree.map(put, expanded, specs)
 
+    def shard_state_local(
+        self, local_state: TrainState, template: TrainState
+    ) -> TrainState:
+        """Place a partial restore (``ShardedCheckpoint.restore_partial``)
+        directly on the mesh: replicated leaves arrive at global shape
+        (rank 0's shard), dim0-sharded leaves arrive as THIS RANK's block
+        and are placed verbatim — no cross-rank reads, no world-sized host
+        reassembly buffer.
+
+        Multi-controller only, one addressable device per process: under
+        that layout a process's single addressable shard of a ``P(axis)``
+        leaf is exactly its own rank's block, so the block from
+        ``restore_partial`` can be handed to ``make_array_from_callback``
+        as-is. Any other device layout must go through the full
+        ``restore`` + ``shard_state`` path.
+
+        ``template`` is the unsharded host template the restore used
+        (``checkpoint_template`` output): it supplies the tree structure
+        and the global shapes the specs are derived from, so placement
+        here and ``checkpoint_spec`` at save time share one eligibility
+        rule and can never disagree.
+        """
+        if jax.process_count() != self.size or jax.local_device_count() != 1:
+            raise ValueError(
+                "shard_state_local needs one process per mesh slot "
+                f"(process_count={jax.process_count()}, "
+                f"local_device_count={jax.local_device_count()}, "
+                f"world={self.size}); use restore + shard_state instead"
+            )
+        # global-shape view for spec derivation: per-replica leaves grow
+        # the leading mesh axis; params/opt leaves are already global in
+        # the template (abstract shapes suffice — nothing is materialized)
+        expanded = template.replace(
+            batch_stats=jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (self.size, *np.shape(x)), np.asarray(x).dtype),
+                template.batch_stats,
+            ),
+            grad_residual=jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (self.size, *np.shape(x)), np.float32),
+                template.grad_residual,
+            ),
+        )
+        specs = self._specs(expanded)
+
+        def put(local, s, ref):
+            host = np.asarray(local)
+            gshape = tuple(ref.shape) if hasattr(ref, "shape") else ()
+            sharding = NamedSharding(self.mesh, s)
+            if s == P():
+                if host.shape != gshape:
+                    raise ValueError(
+                        f"replicated leaf shape {host.shape} != template "
+                        f"{gshape}"
+                    )
+                return jax.make_array_from_callback(
+                    gshape, sharding, lambda idx: host[idx])
+            block = (gshape[0] // self.size, *gshape[1:])
+            if host.shape != block:
+                raise ValueError(
+                    f"local block shape {host.shape} != expected {block} "
+                    f"for global {gshape} over world {self.size}"
+                )
+            # the callback is asked only for this process's own shard,
+            # which IS the restored block
+            return jax.make_array_from_callback(
+                gshape, sharding, lambda idx: host)
+
+        return jax.tree.map(put, local_state, specs, expanded)
+
     def unshard_state(self, state: TrainState, rank: int = 0) -> TrainState:
         """Single-device view: params as-is, rank ``rank``'s BN stats.
 

@@ -584,6 +584,14 @@ class PipelineParallel:
             self._jitted = self._compile_for(state)
         return self._jitted(state, tokens, targets)
 
+    def lower_step(self, state: TrainState, tokens, targets):
+        """AOT-lower the pipelined step without executing it — same hook
+        as ``DataParallel.lower_step`` so the HLO analysis tools (traffic,
+        schedule, graftlint pass 2) can treat every engine uniformly."""
+        if self._jitted is None:
+            self._jitted = self._compile_for(state)
+        return self._jitted.lower(state, tokens, targets)
+
     # -- parity helpers ------------------------------------------------------
 
     def merged_params(self, state: TrainState) -> dict:

@@ -427,3 +427,11 @@ class PjitEngine:
         if self._jitted is None:
             self._jitted = self._build(state)
         return self._jitted(state, images, labels)
+
+    def lower_step(self, state: TrainState, images, labels):
+        """AOT-lower the train step without executing it — same hook as
+        ``DataParallel.lower_step`` so the HLO analysis tools (traffic,
+        schedule, graftlint pass 2) can treat every engine uniformly."""
+        if self._jitted is None:
+            self._jitted = self._build(state)
+        return self._jitted.lower(state, images, labels)

@@ -603,7 +603,13 @@ class HostAgent:
         ]
         if len(acks) < self.cfg.num_agents and time.monotonic() < deadline:
             return
-        td = json.loads(self.kv.get(k_teardown(gen)))
+        # non-blocking: _maybe_resolve only runs after _post_teardown wrote
+        # the record, but a blocking get() here would park the leader past
+        # its lease TTL if the store hiccups — re-observe next tick instead
+        raw_td = self.kv.try_get(k_teardown(gen))
+        if raw_td is None:
+            return
+        td = json.loads(raw_td)
         reports = self._reports(gen)
         outcomes = {r["outcome"] for r in reports.values()}
         if "failure" in outcomes:

@@ -550,6 +550,28 @@ def build_elastic_checkpoint(
         )
 
     def restore_fn():
+        # Partial fast path: at unchanged world size each rank reads only
+        # rank 0's shard and its own (2 files + 2 hash passes instead of
+        # world_size) and places its blocks directly, skipping the global
+        # reassembly buffer. Valid only when every process owns exactly
+        # its own mesh slot; anything surprising — world changed, missing
+        # shard, checksum mismatch — falls back to the full restore below,
+        # which reshards across worlds and can quarantine a rotten step
+        # and walk back to an older sealed one.
+        if (hasattr(dp, "shard_state_local")
+                and jax.process_count() == world_size
+                and jax.local_device_count() == 1):
+            try:
+                res = sc.restore_partial(template)
+            except Exception as e:
+                if verbose:
+                    print(f"[elastic] partial restore unavailable ({e}); "
+                          "falling back to full restore", flush=True)
+            else:
+                if res is None:
+                    return None
+                local_state, meta = res
+                return dp.shard_state_local(local_state, template), meta
         res = sc.restore(template)
         if res is None:
             return None
