@@ -772,6 +772,129 @@ def bench_grad_compress_traffic(world: int = 8) -> dict:
     }
 
 
+# Stub tenants for --metric cluster: real subprocesses speaking the
+# scheduler's protocol (job-namespaced heartbeats, preemption vote,
+# verdict) with zero training inside, so the reported latencies isolate
+# the scheduler's own reaction times. The resumed life smuggles its
+# first-step wall-clock stamp out through the verdict — the one record
+# that survives the job-namespace sweep.
+_CLUSTER_AGENT = """\
+import json, signal, sys, time
+sys.path.insert(0, {root!r})
+from tpu_sandbox.runtime.kvstore import KVClient, for_job
+aid = int(sys.argv[1]); port = int(sys.argv[2]); job = sys.argv[3]
+mode = sys.argv[4]
+kv = for_job(KVClient(port=port), job)
+stop = []
+signal.signal(signal.SIGTERM, lambda s, f: stop.append(1))
+
+def verdict(ok, preempted=False, extra=None):
+    v = {{"ok": ok, "preempted": preempted, "reason": "bench stub",
+          "summary": "", "restarts": 0, "preemptions": 0,
+          "generations": 1}}
+    v.update(extra or {{}})
+    kv.set("job/done", json.dumps(v))
+
+if mode == "work":            # the high-priority arrival: brief and done
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.4:
+        kv.set_ttl(f"agent_hb/{{aid}}", repr(time.time()), 5.0)
+        time.sleep(0.02)
+    verdict(True)
+    time.sleep(0.2)
+elif mode == "preemptible":   # the victim tenant
+    lives = kv.add("bench/lives", 1)
+    if lives >= 2:            # resumed life: stamp the first step, finish
+        verdict(True, extra={{"first_step_walltime": time.time()}})
+        time.sleep(0.2)
+        sys.exit(0)
+    while not stop:           # first life: run until the scheduler preempts
+        kv.set_ttl(f"agent_hb/{{aid}}", repr(time.time()), 5.0)
+        time.sleep(0.02)
+    verdict(False, preempted=True)  # checkpoint-through-vote stand-in
+    sys.exit(75)
+"""
+
+
+def bench_cluster(pool: int = 1) -> dict:
+    """Scheduler control-plane latencies from a scripted two-job run: a
+    low-priority tenant fills the pool, a high-priority job arrives and
+    preempts it, the victim resumes after the arrival drains. Reports the
+    three receipts the multi-tenant claim stands on — queue wait,
+    preempt-to-checkpoint, and resume-to-first-step — computed from the
+    scheduler's own event stamps (runtime/scheduler.py::job_events) plus
+    the stub agents' verdicts. Chipless: no jax, no training; these are
+    the scheduler's overheads, to be added on top of a real job's own
+    checkpoint-save and first-step times."""
+    import tempfile
+
+    from tpu_sandbox.runtime.scheduler import (
+        ClusterScheduler,
+        JobSpec,
+        job_events,
+        k_state,
+        k_verdict,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "bench_cluster_agent.py")
+        with open(script, "w", encoding="utf-8") as f:
+            f.write(_CLUSTER_AGENT.format(root=root))
+
+        def argv(mode):
+            return [sys.executable, script, "{agent_id}", "{kv_port}",
+                    "{job_id}", mode]
+
+        with ClusterScheduler(pool, poll=0.02,
+                              extra_env={"PYTHONPATH": root},
+                              verbose=False) as sched:
+            sched.submit(JobSpec(job_id="victim", hosts=1, world_size=1,
+                                 agent_argv=argv("preemptible")))
+            # outrank the victim only once its agent is demonstrably up
+            # (heartbeating, SIGTERM handler installed) — preempting a gang
+            # mid-exec() measures the kill escalation, not the vote
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                sched._tick()
+                if (sched.kv.try_get(k_state("victim")) or b"") \
+                        == b"running" \
+                        and sched.kv.keys("job/victim/agent_hb/"):
+                    break
+                time.sleep(0.02)
+            sched.submit(JobSpec(job_id="arrival", hosts=1, world_size=1,
+                                 priority=5, agent_argv=argv("work")))
+            states = sched.serve(timeout=120)
+            if states != {"victim": "done", "arrival": "done"}:
+                raise RuntimeError(f"scripted run went sideways: {states}")
+            ev_v = job_events(sched.kv, "victim")
+            ev_a = job_events(sched.kv, "arrival")
+            verdict = json.loads(sched.kv.get(k_verdict("victim")))
+
+    return {
+        "metric": "cluster_scheduler_latency",
+        "pool_hosts": pool,
+        "unit": "seconds",
+        # how long each job sat in the queue before its gang launched
+        # (the arrival's wait covers the whole preemption round trip)
+        "queue_wait_s": {
+            "victim": round(ev_v["admitted"] - ev_v["submitted"], 4),
+            "arrival": round(ev_a["admitted"] - ev_a["submitted"], 4),
+        },
+        # SIGTERM sent -> preempted verdict posted (the window a real job
+        # spends checkpointing through the preemption vote)
+        "preempt_to_checkpoint_s": round(
+            ev_v["preempted"] - ev_v["preempt_sent"], 4),
+        # requeued-job readmission -> its first step after resume
+        "resume_to_first_step_s": round(
+            verdict["first_step_walltime"] - ev_v["readmitted"], 4),
+        "events": {"victim": ev_v, "arrival": ev_a},
+        "source": "scripted two-job preemption round on a 1-host pool with "
+                  "protocol-stub agents (scheduler overhead only; add the "
+                  "job's own checkpoint-save and first-step cost)",
+    }
+
+
 def _measure_input_stall(n_batches: int = 30, load_ms: float = 10.0,
                          step_ms: float = 10.0) -> dict:
     """Measured wall-time of a sleep-modeled train loop with and without
@@ -1497,7 +1620,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
                    choices=["grad_compress", "overlap", "donation",
-                            "images_per_sec",
+                            "cluster", "images_per_sec",
                             "allreduce_bw", "pallas",
                             "capacity", "seq_scaling", "lm", "sweep",
                             "convergence"],
@@ -1535,6 +1658,10 @@ def main():
     if args.metric == "donation":
         # chipless AOT memory analysis (subprocess-isolated); no probe
         print(json.dumps(bench_donation()))
+        return
+    if args.metric == "cluster":
+        # chipless scheduler control-plane timing (stub tenants); no probe
+        print(json.dumps(bench_cluster()))
         return
     if args.metric != "images_per_sec":
         # probe-timeout 0 means "trust the environment" (same semantics as

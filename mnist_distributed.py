@@ -244,7 +244,7 @@ def train_elastic_worker(args, world_size):
 
     from tpu_sandbox.runtime import Heartbeat, bootstrap, wait_for_world
     from tpu_sandbox.runtime.faults import FaultInjector, FaultPlan
-    from tpu_sandbox.runtime.kvstore import KVClient
+    from tpu_sandbox.runtime.kvstore import KVClient, for_job
     from tpu_sandbox.train import (
         PREEMPTED_EXIT_CODE,
         ElasticEnv,
@@ -257,7 +257,10 @@ def train_elastic_worker(args, world_size):
 
     rank = args.rank
     eenv = ElasticEnv.from_env()  # generation + owning host agent (if any)
-    kv = KVClient(port=int(args.kv_port))
+    # job-scoped store view: under the cluster scheduler every runtime key
+    # this rank touches (heartbeats, fault claims, barriers, job/done)
+    # lives inside job/<id>/ — a neighbor job can never see or be seen
+    kv = for_job(KVClient(port=int(args.kv_port)), eenv.job_id)
     hb = Heartbeat(kv, rank, interval=0.5).start()
     preemption = PreemptionHandler(kv)
     plan = FaultPlan.from_env()
@@ -514,6 +517,7 @@ def _agent_config_from_env(args, world_size, kv_port):
         num_agents=args.agents,
         world_size=world_size,
         kv_port=kv_port,
+        job_id=args.job_id or os.environ.get("TPU_SANDBOX_JOB_ID", ""),
         max_restarts=args.max_restarts,
         backoff=knob("TPU_SANDBOX_BACKOFF", 1.0),
         heartbeat_timeout=knob("TPU_SANDBOX_WATCHDOG_TIMEOUT", 60.0),
@@ -583,9 +587,10 @@ def spawn_elastic_agents(args, world_size):
     from tpu_sandbox.runtime.host_agent import AgentLauncher
 
     _validate_fault_plan()
-    if world_size % args.agents:
+    if world_size < args.agents:
         raise SystemExit(
-            f"world size {world_size} must divide by --agents {args.agents}"
+            f"world size {world_size} gives --agents {args.agents} "
+            "nothing to run on some hosts (every agent owns >= 1 rank)"
         )
     if not args.ckpt_dir:
         print("note: --elastic without --ckpt-dir restarts from step 0 "
@@ -602,6 +607,44 @@ def spawn_elastic_agents(args, world_size):
     rc = AgentLauncher(args.agents, agent_cmd).run()
     if rc:
         sys.exit(rc)
+
+
+def run_cluster_pool(args, world_size):
+    """Multi-tenant cluster mode: gang-schedule this training job through
+    the durable queue of runtime/scheduler.py on a pool of --pool host
+    slots. Same agent topology as --agents N, but admitted (and possibly
+    queued or preempted) by the scheduler instead of launched directly —
+    the entry point that exercises one mesh as one tenant of a shared
+    pool."""
+    import sys
+
+    from tpu_sandbox.runtime.scheduler import ClusterScheduler, JobSpec
+
+    _validate_fault_plan()
+    agents = args.agents or 1
+    if not args.ckpt_dir:
+        print("note: --elastic without --ckpt-dir restarts from step 0 "
+              "(pass --ckpt-dir/--ckpt-every to resume where the crash hit)")
+
+    passthrough = _elastic_passthrough(args)
+    job_id = args.job_id or "job0"
+    spec = JobSpec(
+        job_id=job_id,
+        hosts=agents,
+        world_size=world_size,
+        agent_argv=[sys.executable, __file__, "--elastic",
+                    "--agents", str(agents), "--agent-id", "{agent_id}",
+                    "--kv-port", "{kv_port}", "--job-id", "{job_id}",
+                    "--max-restarts", str(args.max_restarts), *passthrough],
+        priority=args.priority,
+    )
+    with ClusterScheduler(args.pool) as sched:
+        sched.submit(spec)
+        states = sched.serve()
+    state = states.get(job_id)
+    print(f"[cluster] job {job_id!r} finished: {state}", flush=True)
+    if state != "done":
+        sys.exit(1)
 
 
 def spawn_multiprocess(args, world_size):
@@ -763,6 +806,8 @@ def main():
         train_elastic_worker(args, world_size)
     elif args.agent_id is not None:
         run_host_agent(args, world_size)
+    elif args.elastic and args.pool:
+        run_cluster_pool(args, world_size)
     elif args.elastic and args.agents:
         spawn_elastic_agents(args, world_size)
     elif args.elastic:

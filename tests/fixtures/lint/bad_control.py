@@ -1,11 +1,27 @@
 """Seeded GL-R3xx violations — every pattern here must be FLAGGED.
 
 Mirrors the control-plane idioms of ``runtime/`` with each guard removed.
-Never imported; fed to ``analysis.control_pass.lint_source`` as text.
+Never imported; fed to ``analysis.control_pass.lint_source`` as text
+(so the jax import below is only ever parsed, never executed).
 """
 
 import threading
 import time
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def _sync_grads(g):
+    return lax.psum(g, "data")  # collective: every dispatch is a rendezvous
+
+
+def drain_microbatches(batches):  # GL-R305: per-iteration dispatch storm
+    out = []
+    for b in batches:
+        out.append(_sync_grads(b))
+    return out
 
 
 def k_static_claim():

@@ -8,6 +8,31 @@ reads inside leader sections.
 import threading
 import time
 
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def _sync_grads_scanned(gs):
+    # the whole drain is ONE program: the loop lives inside the jit, so
+    # there is a single dispatch (and a single rendezvous schedule)
+    return lax.scan(lambda c, g: (c, lax.psum(g, "data")), None, gs)[1]
+
+
+def drain_microbatches(batches):
+    return _sync_grads_scanned(batches)
+
+
+_local_norm = jax.jit(jnp.sum)  # single-device jit: loops over it are fine
+
+
+def accumulate_norms(chunks):
+    total = 0.0
+    for c in chunks:  # no collective in the dispatched program
+        total += float(_local_norm(c))
+    return total
+
 
 def k_gen_claim(gen):
     return f"budget/claim/{gen}"  # per-generation discriminator

@@ -26,6 +26,7 @@ from tpu_sandbox.runtime.host_agent import (
     K_JOB_DONE,
     K_PREEMPTIONS,
     K_RESTARTS,
+    assign_ranks,
     ranks_for_agent,
 )
 from tpu_sandbox.runtime.kvstore import KVClient, KVServer
@@ -40,8 +41,23 @@ def test_ranks_for_agent_contiguous_blocks():
     assert ranks_for_agent(0, 2, 4) == [0, 1]
     assert ranks_for_agent(1, 2, 4) == [2, 3]
     assert ranks_for_agent(2, 3, 3) == [2]
-    with pytest.raises(ValueError, match="not divisible"):
-        ranks_for_agent(0, 3, 4)
+
+
+def test_assign_ranks_heterogeneous():
+    # uneven worlds split into balanced contiguous blocks, extras first
+    assert assign_ranks(3, 2) == [[0, 1], [2]]
+    assert assign_ranks(7, 3) == [[0, 1, 2], [3, 4], [5, 6]]
+    assert assign_ranks(4, 4) == [[0], [1], [2], [3]]
+    # every world size covers exactly ranks 0..world-1, in order
+    for world in range(1, 12):
+        for agents in range(1, world + 1):
+            flat = [r for b in assign_ranks(world, agents) for r in b]
+            assert flat == list(range(world))
+    # an over-provisioned gang is an admission-time error, never idle hosts
+    with pytest.raises(ValueError, match="at least one rank"):
+        assign_ranks(2, 3)
+    with pytest.raises(ValueError, match="num_agents"):
+        assign_ranks(4, 0)
 
 
 # -- RankGroup -------------------------------------------------------------

@@ -9,7 +9,46 @@ import time
 import pytest
 
 from tpu_sandbox.runtime.bootstrap import find_free_port
-from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.kvstore import KVClient, KVServer, _backoff_delays
+
+
+# -- the backoff schedule itself -------------------------------------------
+
+
+def test_backoff_delays_grow_exponentially_with_jitter():
+    # list() never sleeps, so the generator busy-yields for the whole
+    # wall-clock window — keep it short
+    delays = list(_backoff_delays(0.2, base=0.02, cap=10.0))
+    assert delays, "deadline should allow at least one retry"
+    # every delay is its exponential envelope scaled by a factor in
+    # [0.5, 1.5): never zero (no busy-spin), never a lockstep constant
+    for i, d in enumerate(delays[:5]):
+        envelope = 0.02 * (2 ** i)
+        assert 0.5 * envelope <= d < 1.5 * envelope or d <= envelope, (
+            i, d, envelope)
+    assert all(d > 0 for d in delays)
+    # jitter: a second schedule should not replay the first exactly
+    again = list(_backoff_delays(0.2, base=0.02, cap=10.0))
+    assert delays[:3] != again[:3]
+
+
+def test_backoff_delays_respect_cap_and_deadline():
+    t0 = time.monotonic()
+    total = 0.0
+    for d in _backoff_delays(0.4, base=0.05, cap=0.1):
+        assert d <= 0.1 * 1.5 + 1e-9  # capped envelope x max jitter factor
+        assert d <= 0.4 + 1e-9  # no single sleep overshoots the deadline
+        total += d
+        time.sleep(d)
+    # the generator exhausts AT the deadline: the loop above slept through
+    # ~the whole window and not multiples of it
+    elapsed = time.monotonic() - t0
+    assert 0.3 <= elapsed < 2.0, elapsed
+
+
+def test_backoff_delays_zero_timeout_gives_up_immediately():
+    assert list(_backoff_delays(0.0)) == []
+    assert list(_backoff_delays(-1.0)) == []
 
 
 def test_connect_retries_until_server_appears():

@@ -88,12 +88,15 @@ def test_bad_control_fixture_fires_every_rule():
     by_rule = {}
     for f in findings:
         by_rule.setdefault(f.rule, []).append(f)
-    assert set(by_rule) == {"GL-R301", "GL-R302", "GL-R303", "GL-R304"}
+    assert set(by_rule) == {"GL-R301", "GL-R302", "GL-R303", "GL-R304",
+                            "GL-R305"}
     # both claim spellings: constant key AND unscoped key helper
     assert len(by_rule["GL-R301"]) == 2
     # leader-reachability: the blocking get() is inside _resolve, reached
     # from _leader_tick
     assert "_resolve" in by_rule["GL-R304"][0].message
+    # the launch storm anchors on the dispatch site inside the loop
+    assert "_sync_grads" in by_rule["GL-R305"][0].snippet
 
 
 def test_clean_control_fixture_passes():

@@ -1,0 +1,410 @@
+"""Cluster scheduler control plane, fast (fake agents, no jax): the
+durable queue API, per-job KV namespacing, gang admission, priority
+preemption, admission timeouts with namespace sweeps, and scheduler-death
+adoption (satellite: random kill orders must leave the surviving job
+undamaged and un-double-charged). The full two-job fault matrix with real
+training runs slow in test_cluster_integration.py."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_sandbox.runtime.kvstore import (
+    KVClient,
+    KVServer,
+    NamespacedKV,
+    for_job,
+    job_namespace,
+)
+from tpu_sandbox.runtime.scheduler import (
+    ClusterScheduler,
+    JobSpec,
+    cancel_job,
+    job_events,
+    k_state,
+    k_verdict,
+    list_jobs,
+    submit_job,
+)
+
+PY = sys.executable
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {"PYTHONPATH": ROOT}
+
+
+# -- per-job namespacing (kvstore layer) -----------------------------------
+
+
+def test_job_namespace_spelling():
+    assert job_namespace("") == ""
+    assert job_namespace("default") == ""  # bare-prefix default-job alias
+    assert job_namespace("alpha") == "job/alpha/"
+    for bad in ("a/b", "a b", "a\tb", "a\nb"):
+        with pytest.raises(ValueError):
+            job_namespace(bad)
+
+
+def test_for_job_default_is_identity_and_jobs_are_isolated():
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        assert for_job(kv, "") is kv
+        assert for_job(kv, "default") is kv
+        a = for_job(kv, "a")
+        b = for_job(kv, "b")
+        assert isinstance(a, NamespacedKV)
+        a.set("leader/term", b"3")
+        b.set("leader/term", b"7")
+        kv.set("leader/term", b"1")  # the default job's view
+        # three elections, three stores-within-the-store
+        assert a.get("leader/term") == b"3"
+        assert b.get("leader/term") == b"7"
+        assert kv.get("leader/term") == b"1"
+        assert kv.get("job/a/leader/term") == b"3"  # where it really lives
+        # keys() is namespace-relative; the sweep is namespace-bounded
+        assert a.keys("leader/") == ["leader/term"]
+        a.set("budget/restarts", b"1")
+        assert a.delete_prefix("") == 2  # whole-job sweep, nobody else's
+        assert kv.get("job/b/leader/term") == b"7"
+        assert kv.get("leader/term") == b"1"
+        # nesting two job prefixes is always a bug
+        with pytest.raises(ValueError, match="nest"):
+            for_job(a, "c")
+        kv.close()
+
+
+def test_namespaced_add_and_barrier():
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        a = for_job(kv, "a")
+        assert a.add("budget/claim/1", 1) == 1
+        assert a.add("budget/claim/1", 1) == 2
+        assert kv.add("budget/claim/1", 1) == 1  # default job unaffected
+        a.barrier(1, key="sync")  # single-member barrier completes
+        kv.close()
+
+
+# -- JobSpec validation ----------------------------------------------------
+
+
+def test_job_spec_validation():
+    ok = dict(hosts=1, world_size=1, agent_argv=["true"])
+    JobSpec(job_id="fine", **ok)
+    with pytest.raises(ValueError, match="real job id"):
+        JobSpec(job_id="", **ok)
+    with pytest.raises(ValueError, match="real job id"):
+        JobSpec(job_id="default", **ok)
+    with pytest.raises(ValueError):
+        JobSpec(job_id="has/slash", **ok)
+    with pytest.raises(ValueError, match="hosts"):
+        JobSpec(job_id="j", hosts=0, world_size=1, agent_argv=["true"])
+    # gang shape: every host must own at least one rank
+    with pytest.raises(ValueError, match="at least one rank"):
+        JobSpec(job_id="j", hosts=3, world_size=2, agent_argv=["true"])
+    # template placeholders are validated at submit time, not spawn time
+    with pytest.raises(ValueError, match="template"):
+        JobSpec(job_id="j", hosts=1, world_size=1,
+                agent_argv=["run", "--x", "{unknown_placeholder}"])
+    spec = JobSpec(job_id="j", hosts=2, world_size=3,
+                   agent_argv=["run", "{agent_id}", "{kv_port}", "{job_id}",
+                               "{num_agents}", "{world_size}"])
+    assert spec.format_argv(agent_id=1, kv_port=99) == \
+        ["run", "1", "99", "j", "2", "3"]
+    assert JobSpec.from_json(spec.to_json()) == spec
+
+
+# -- durable queue API -----------------------------------------------------
+
+
+def test_submit_list_cancel_roundtrip():
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        s1 = submit_job(kv, JobSpec(job_id="a", hosts=1, world_size=1,
+                                    agent_argv=["true"], priority=2))
+        s2 = submit_job(kv, JobSpec(job_id="b", hosts=2, world_size=2,
+                                    agent_argv=["true"]))
+        assert s2 == s1 + 1
+        jobs = list_jobs(kv)
+        assert [j["job_id"] for j in jobs] == ["a", "b"]
+        assert jobs[0] == {"job_id": "a", "state": "queued", "seq": s1,
+                           "priority": 2, "hosts": 1, "world_size": 1}
+        with pytest.raises(ValueError, match="already exists"):
+            submit_job(kv, JobSpec(job_id="a", hosts=1, world_size=1,
+                                   agent_argv=["true"]))
+        assert "submitted" in job_events(kv, "a")
+        cancel_job(kv, "a")
+        assert kv.try_get("sched/jobs/a/cancel") == b"1"
+        kv.close()
+
+
+# -- fake agents -----------------------------------------------------------
+#
+# Each agent is a real subprocess speaking the job-namespaced protocol the
+# scheduler watches: heartbeat under agent_hb/<id>, verdict to job/done.
+# Mirrors test_host_agent's _FAKE_AGENT idiom, one level up the stack.
+
+_AGENT = """
+import importlib.util, json, os, signal, sys, time
+# load kvstore.py directly: the package __init__ drags in jax, which is
+# ~0.5s of startup tax on each of the ~16 agents this suite spawns
+_spec = importlib.util.spec_from_file_location(
+    "_kv", os.path.join({root!r}, "tpu_sandbox", "runtime", "kvstore.py"))
+_kv = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_kv)
+KVClient, for_job = _kv.KVClient, _kv.for_job
+aid = int(sys.argv[1]); port = int(sys.argv[2]); job = sys.argv[3]
+mode = sys.argv[4]; arg = float(sys.argv[5]) if len(sys.argv) > 5 else 0.0
+kv = for_job(KVClient(port=port), job)
+kv.set(f"test/ran/{{aid}}", str(os.getpid()))
+stop = []
+signal.signal(signal.SIGTERM, lambda s, f: stop.append(1))
+
+def beat():
+    kv.set_ttl(f"agent_hb/{{aid}}", repr(time.time()), 5.0)
+
+def done(ok, preempted=False):
+    if aid == 0:
+        kv.set("job/done", json.dumps(
+            {{"ok": ok, "preempted": preempted, "reason": "fake agent",
+              "summary": "", "restarts": int(kv.try_get("budget/restarts")
+                                             or 0),
+              "preemptions": 0, "generations": 1}}))
+
+if mode == "work":        # heartbeat for `arg` seconds, then succeed
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < arg and not stop:
+        beat(); time.sleep(0.03)
+    if stop:
+        done(False, preempted=True); sys.exit(75)
+    done(True); time.sleep(0.1); sys.exit(0)
+elif mode == "mortal":      # first life runs long; respawned lives crash
+    lives = kv.add("test/lives", 1)
+    if lives >= 2:
+        sys.exit(9)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60 and not stop:
+        beat(); time.sleep(0.03)
+    sys.exit(75 if stop else 0)
+elif mode == "preemptible":
+    # first life: run until SIGTERM, checkpoint-through-preemption;
+    # second life: note the resume and finish clean, uncharged
+    lives = kv.add("test/lives", 1)
+    if lives >= 2:
+        kv.set("test/resumed", b"1")
+        done(True); time.sleep(0.1); sys.exit(0)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60 and not stop:
+        beat(); time.sleep(0.03)
+    done(False, preempted=True)
+    sys.exit(75)
+"""
+
+
+def _agent_argv(script, mode, arg=0.0):
+    return [PY, str(script), "{agent_id}", "{kv_port}", "{job_id}",
+            mode, str(arg)]
+
+
+@pytest.fixture()
+def agent_script(tmp_path):
+    script = tmp_path / "fake_sched_agent.py"
+    script.write_text(_AGENT.format(root=ROOT))
+    return script
+
+
+# -- gang admission --------------------------------------------------------
+
+
+def test_gang_is_all_or_nothing(agent_script):
+    """Pool of 3, two 2-host jobs: the second must not launch ANY agent
+    (not even for the one free slot) until the first gang's slots free."""
+    with ClusterScheduler(3, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(job_id="first", hosts=2, world_size=3,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.5)))
+        sched.submit(JobSpec(job_id="second", hosts=2, world_size=2,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.1)))
+        saw_partial = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            states = {j["job_id"]: j["state"] for j in list_jobs(sched.kv)}
+            second_agents = sched.kv.keys("job/second/test/ran/")
+            if states.get("first") == "running" \
+                    and states.get("second") == "queued" \
+                    and second_agents:
+                saw_partial.append(second_agents)
+            if states.get("second") != "queued":
+                break
+            sched._tick()
+            time.sleep(0.02)
+        states = sched.serve(timeout=60)
+        assert saw_partial == [], "gang launched while still queued"
+        assert states == {"first": "done", "second": "done"}, states
+        # both gangs eventually ran with their FULL host set
+        ev = job_events(sched.kv, "second")
+        assert ev["admitted"] >= ev["submitted"]
+
+
+def test_heterogeneous_world_sizes_share_the_pool(agent_script):
+    """3 ranks on 2 hosts next to 1 rank on 1 host: world % hosts != 0 is
+    admissible (the launch record carries the rank table — unit-proven in
+    test_host_agent.test_assign_ranks_heterogeneous)."""
+    with ClusterScheduler(3, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(job_id="train", hosts=2, world_size=3,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.3)))
+        sched.submit(JobSpec(job_id="bench", hosts=1, world_size=1,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.3)))
+        states = sched.serve(timeout=60)
+        assert states == {"train": "done", "bench": "done"}, states
+        # both gangs' namespaces were swept on completion
+        assert sched.kv.keys("job/train/") == []
+        assert sched.kv.keys("job/bench/") == []
+
+
+# -- priority preemption ---------------------------------------------------
+
+
+def test_priority_preemption_checkpoints_and_resumes(agent_script):
+    """Full pool, high-priority arrival: the low-priority job is SIGTERMed,
+    posts a preempted (uncharged) verdict, re-queues at its original seq,
+    and resumes after the high-priority job drains."""
+    with ClusterScheduler(1, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        low_seq = sched.submit(
+            JobSpec(job_id="low", hosts=1, world_size=1, priority=0,
+                    agent_argv=_agent_argv(agent_script, "preemptible")))
+        # wait until low is actually running before outranking it
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched._tick()
+            state = (sched.kv.try_get(k_state("low")) or b"").decode()
+            if state == "running" and sched.kv.keys("job/low/test/ran/"):
+                break
+            time.sleep(0.02)
+        sched.submit(
+            JobSpec(job_id="high", hosts=1, world_size=1, priority=5,
+                    agent_argv=_agent_argv(agent_script, "work", 0.3)))
+        states = sched.serve(timeout=120)
+        assert states == {"low": "done", "high": "done"}, states
+        # the victim kept its place in line (seq unchanged through requeue)
+        jobs = {j["job_id"]: j for j in list_jobs(sched.kv)}
+        assert jobs["low"]["seq"] == low_seq
+        ev_low = job_events(sched.kv, "low")
+        ev_high = job_events(sched.kv, "high")
+        # the bench.py receipts, in causal order on the scheduler's clock
+        assert ev_low["admitted"] <= ev_low["preempt_sent"] \
+            <= ev_low["preempted"] <= ev_low["readmitted"]
+        assert ev_high["admitted"] >= ev_low["preempt_sent"]
+        # preemption was free: the resumed verdict charges no restarts
+        verdict = json.loads(sched.kv.get(k_verdict("low")))
+        assert verdict["ok"] and verdict["restarts"] == 0
+
+
+# -- admission deadline + sweep --------------------------------------------
+
+
+def test_unsatisfiable_job_times_out_with_clean_namespace(agent_script):
+    with ClusterScheduler(1, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.start()
+        # leaked-looking state from a previous life of the same id: the
+        # sweep must take it out with the timeout
+        ghost = for_job(sched.kv, "huge")
+        ghost.set("leader/term", b"9")
+        ghost.set("budget/claim/3", b"2")
+        sched.submit(JobSpec(job_id="huge", hosts=4, world_size=4,
+                             agent_argv=_agent_argv(agent_script, "work"),
+                             admission_timeout=0.3))
+        states = sched.serve(timeout=30)
+        assert states == {"huge": "timeout"}, states
+        # THE namespace-sweep assertion: no leaked claims anywhere
+        assert sched.kv.keys(job_namespace("huge")) == []
+        assert "timeout" in job_events(sched.kv, "huge")
+
+
+# -- scheduler death / adoption (satellite: random kill orders) ------------
+
+
+def _spawn_scheduler_proc(port, pool):
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from tpu_sandbox.runtime.scheduler import ClusterScheduler\n"
+        "ClusterScheduler(%d, kv_port=%d, poll=0.02,\n"
+        "                 verbose=False).serve(timeout=120)\n"
+        % (ROOT, pool, port)
+    )
+    return subprocess.Popen([PY, "-c", code],
+                            env={**os.environ, "PYTHONPATH": ROOT})
+
+
+@pytest.mark.parametrize("kill_order", [
+    ("scheduler", "victim_agent"),
+    ("victim_agent", "scheduler"),
+])
+def test_scheduler_death_leaves_survivor_unharmed(agent_script, kill_order):
+    """Kill the scheduler process and one job's agent in both orders: the
+    OTHER job must finish clean (no deadlock) with zero restarts charged
+    (no double-charge), reaped by a successor scheduler that adopts what
+    the dead one left running."""
+    with KVServer() as srv:
+        kv = KVClient(port=srv.port)
+        submit_job(kv, JobSpec(
+            job_id="victim", hosts=1, world_size=1,
+            agent_argv=_agent_argv(agent_script, "mortal")))
+        submit_job(kv, JobSpec(
+            job_id="survivor", hosts=1, world_size=1,
+            agent_argv=_agent_argv(agent_script, "work", 2.0)))
+        sched1 = _spawn_scheduler_proc(srv.port, pool=2)
+        try:
+            # wait for both gangs to be up (agents registered their pids)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if kv.keys("job/victim/test/ran/") \
+                        and kv.keys("job/survivor/test/ran/"):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("jobs never started under the scheduler")
+            victim_pid = int(kv.get("job/victim/test/ran/0"))
+            for target in kill_order:
+                if target == "scheduler":
+                    sched1.kill()
+                    sched1.wait()
+                else:
+                    os.kill(victim_pid, signal.SIGKILL)
+                time.sleep(0.1)
+            # the survivor's agent is parented to the dead scheduler but
+            # keeps running — its verdict lands without any scheduler
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if kv.try_get("job/survivor/job/done") is not None:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("survivor deadlocked after the kills")
+            # a successor adopts the wreckage: survivor reaped as done,
+            # the victim's dead gang detected by silence and failed
+            with ClusterScheduler(2, kv_port=srv.port, poll=0.02,
+                                  adopt_timeout=1.0, verbose=False) as s2:
+                states = s2.serve(timeout=120)
+            assert states["survivor"] == "done", states
+            assert states["victim"] == "failed", states
+            verdict = json.loads(kv.get(k_verdict("survivor")))
+            assert verdict["ok"] and verdict["restarts"] == 0
+            # both namespaces swept; neither job can leak into a third
+            assert kv.keys("job/survivor/") == []
+            assert kv.keys("job/victim/") == []
+        finally:
+            if sched1.poll() is None:
+                sched1.kill()
+                sched1.wait()
+            kv.close()

@@ -1,6 +1,6 @@
 """Pass 3: control-plane lint over ``runtime/`` (AST).
 
-Four rules distilled from this repo's own elastic-runtime incident
+Five rules distilled from this repo's own elastic-runtime incident
 history:
 
 - **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
@@ -21,6 +21,14 @@ history:
   method (``_leader*`` roots, intra-class call graph). A blocking read
   can park the leader past its lease TTL; leader ticks must use
   ``try_get`` and re-observe next tick.
+- **GL-R305** — a Python ``for``/``while`` loop dispatching a
+  *multi-device* jitted computation (one whose body runs a collective,
+  or a ``shard_map``) per iteration. Every dispatch is a fresh
+  cross-device rendezvous; on XLA:CPU a storm of them interleaves
+  across ranks until two ranks wait in different rendezvous and the
+  job deadlocks (the ROADMAP launch-storm carry-over). Batch the loop
+  into the program (``lax.scan``/``fori_loop``) or hoist the dispatch
+  out of the loop.
 """
 
 from __future__ import annotations
@@ -382,6 +390,140 @@ def _check_leader_blocking_reads(
                 ))
 
 
+# -- GL-R305 (module-level) --------------------------------------------------
+
+#: cross-device rendezvous primitives — a jit whose trace hits one of
+#: these runs on every device of the mesh, so each dispatch is a
+#: collective rendezvous (shard_map-wrapped fns are multi-device by
+#: construction)
+_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "ppermute", "pshuffle", "all_to_all",
+})
+
+
+def _calls_collective(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _final_attr(node.func)
+            if name in _COLLECTIVES or name == "shard_map":
+                return True
+    return False
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` (bare or decorator), incl. the
+    ``partial(jax.jit, ...)`` decorator form."""
+    if isinstance(node, ast.Call):
+        fname = _final_attr(node.func)
+        if fname == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return fname == "jit"
+    return _final_attr(node) == "jit"
+
+
+def _wrapped_is_multi_device(arg: ast.AST, coll_fns: set[str]) -> bool:
+    """Does ``jax.jit(<arg>)`` trace a collective? ``<arg>`` is a known
+    collective-calling function name, a lambda with a collective, or a
+    ``shard_map(...)`` expression."""
+    if isinstance(arg, ast.Name):
+        return arg.id in coll_fns
+    if isinstance(arg, ast.Lambda):
+        return _calls_collective(arg)
+    if isinstance(arg, ast.Call):
+        if _final_attr(arg.func) == "shard_map":
+            return True
+        if _final_attr(arg.func) == "partial" and arg.args:
+            return _wrapped_is_multi_device(arg.args[0], coll_fns)
+    return False
+
+
+def _multi_device_jits(
+    tree: ast.Module,
+) -> tuple[set[str], set[str], set[ast.AST]]:
+    """(names bound to multi-device jitted callables, names of functions
+    that call collectives, jit-decorated defs).
+
+    The last set matters for scoping: a loop *inside* a jitted function
+    is traced into one program (one dispatch), so it is exempt.
+    """
+    coll_fns = {
+        node.name for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _calls_collective(node)
+    }
+    jitted: set[str] = set()
+    traced_defs: set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                traced_defs.add(node)
+                if node.name in coll_fns:
+                    jitted.add(node.name)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _is_jit_expr(node.value.func) \
+                and node.value.args \
+                and _wrapped_is_multi_device(node.value.args[0], coll_fns):
+            name = _final_attr(node.targets[0])
+            if name:
+                jitted.add(name)
+    return jitted, coll_fns, traced_defs
+
+
+def _loops_outside_traced(tree: ast.Module, traced_defs: set[ast.AST]):
+    """Yield every For/While whose dispatches happen at Python speed —
+    i.e. not inside a jit-decorated function body."""
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if child in traced_defs:
+                continue
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                yield child
+            yield from visit(child)
+    yield from visit(tree)
+
+
+def _check_launch_storms(
+    tree: ast.Module, path: str, lines: list[str],
+    findings: list[Finding],
+) -> None:
+    jitted, coll_fns, traced_defs = _multi_device_jits(tree)
+    if not jitted and not coll_fns:
+        return
+    for loop in _loops_outside_traced(tree, traced_defs):
+        bodies = list(loop.body) + list(loop.orelse)
+        if isinstance(loop, ast.While):
+            bodies.append(loop.test)
+        for part in bodies:
+            for node in ast.walk(part):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _final_attr(node.func)
+                dispatches = name in jitted
+                if not dispatches and isinstance(node.func, ast.Call):
+                    # inline form: jax.jit(f)(x) inside the loop — a
+                    # storm AND a retrace per iteration
+                    call = node.func
+                    dispatches = bool(
+                        _is_jit_expr(call.func) and call.args
+                        and _wrapped_is_multi_device(call.args[0],
+                                                     coll_fns)
+                    )
+                if dispatches:
+                    ln = getattr(node, "lineno", 0)
+                    snippet = lines[ln - 1].strip() \
+                        if 0 < ln <= len(lines) else ""
+                    findings.append(make_finding(
+                        "GL-R305", path, ln,
+                        "Python loop dispatches a multi-device jitted "
+                        "computation per iteration — each dispatch is a "
+                        "collective rendezvous; the resulting launch "
+                        "storm deadlocks XLA:CPU gangs",
+                        snippet=snippet,
+                    ))
+
+
 def lint_source(source: str, path: str) -> list[Finding]:
     try:
         tree = ast.parse(source)
@@ -400,6 +542,7 @@ def lint_source(source: str, path: str) -> list[Finding]:
             linter.run_common(node)
         elif isinstance(node, ast.ClassDef):
             _check_leader_blocking_reads(node, path, lines, findings)
+    _check_launch_storms(tree, path, lines, findings)
     return findings
 
 

@@ -106,6 +106,13 @@ RULES: dict[str, tuple[str, str]] = {
         "takes over while this one still thinks it leads); use try_get() "
         "and re-observe next tick",
     ),
+    "GL-R305": (
+        "Python loop dispatching a multi-device jitted fn per iteration",
+        "each dispatch of a collective-bearing jit is a cross-device "
+        "rendezvous; a Python-speed storm of them interleaves across "
+        "ranks and deadlocks XLA:CPU gangs — batch the loop into the "
+        "program (lax.scan / fori_loop) or hoist the dispatch out",
+    ),
 }
 
 

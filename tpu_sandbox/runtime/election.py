@@ -12,6 +12,11 @@ the election builds on the two primitives it does have:
   stops renewing, the lease evaporates, and any observer of the vacancy
   runs a new election at a higher term.
 
+Multi-tenancy note: the election never namespaces its own keys — isolation
+comes from the *client*. Hand it a job-scoped view (``kvstore.for_job``)
+and two jobs sharing one store run fully independent elections under
+``job/<id>/leader/*`` without this module knowing jobs exist.
+
 Key layout (under ``prefix``, default ``leader``):
 
 - ``<p>/term``       — highest *established* term (plain int, set by the

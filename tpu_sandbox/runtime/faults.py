@@ -161,6 +161,9 @@ class FaultInjector:
     survives worker restarts — the claim, not the process, is what makes a
     kill-at-step-7 happen once instead of on every replay of step 7.
     Without a store (single-process tests) claims are process-local.
+    In cluster mode hand a job-scoped view (``kvstore.for_job``): claims
+    and agent mailboxes then live inside the job's namespace, so job A's
+    fault plan can never fire on (or be claimed by) job B.
 
     ``on_hang_heartbeat``: callback that silences this rank's liveness
     publishing (wire it to ``Heartbeat.stop``); the process itself keeps

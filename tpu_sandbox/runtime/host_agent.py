@@ -21,10 +21,14 @@ Popens every rank locally, so a real multi-host job dies with the first
   was posted, which acks/reports landed, what was already charged) from
   the store, which is what makes leader death mid-generation survivable.
 
-KV schema (all under the job's store)::
+KV schema (all under the job's namespace — bare keys for the default job,
+``job/<id>/``-prefixed for every other job; see kvstore.for_job)::
 
     elastic/generation          current generation number (int)
-    gen/<n>/launch              launch command {world_size, at_gen}
+    gen/<n>/launch              launch command {world_size, at_gen, assign}
+                                where assign is the rank-assignment table
+                                {agent_id: [ranks...]} (heterogeneous hosts:
+                                world_size need not divide by num_agents)
     gen/<n>/coordinator         jax.distributed port, set by rank-0's agent
     gen/<n>/ack/launch/<a>      agent <a> spawned its ranks for gen n
     gen/<n>/teardown            teardown command {reason, kind}
@@ -57,7 +61,13 @@ from typing import Callable, Mapping, Sequence
 
 from tpu_sandbox.runtime.election import LeaseElection
 from tpu_sandbox.runtime.faults import agent_cmd_key
-from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.kvstore import (
+    ENV_JOB_ID,
+    DEFAULT_JOB,
+    KVClient,
+    KVServer,
+    for_job,
+)
 from tpu_sandbox.runtime.supervisor import (
     ENV_GENERATION,
     ENV_KV_PORT,
@@ -107,16 +117,36 @@ def k_charge_claim(gen: int) -> str:
     return f"budget/claim/{gen}"
 
 
+def assign_ranks(world_size: int, num_agents: int) -> list[list[int]]:
+    """Balanced contiguous rank blocks for heterogeneous gangs.
+
+    ``world_size`` need not divide evenly: the first ``world % agents``
+    agents take one extra rank (e.g. world 3 on 2 hosts -> [[0, 1], [2]]).
+    Contiguity is load-bearing — rank 0 (the jax.distributed coordinator)
+    always lands on agent 0, and checkpoint shard locality per host stays
+    a contiguous slice. Every agent gets at least one rank: a host with
+    nothing to run can't ack launches, so an over-provisioned gang is an
+    admission-time error, not a silent idle host."""
+    if num_agents < 1:
+        raise ValueError(f"num_agents must be >= 1, got {num_agents}")
+    if world_size < num_agents:
+        raise ValueError(
+            f"world_size {world_size} < {num_agents} agents: every host "
+            "must own at least one rank"
+        )
+    base, extra = divmod(world_size, num_agents)
+    blocks, start = [], 0
+    for a in range(num_agents):
+        n = base + (1 if a < extra else 0)
+        blocks.append(list(range(start, start + n)))
+        start += n
+    return blocks
+
+
 def ranks_for_agent(agent_id: int, num_agents: int, world_size: int
                     ) -> list[int]:
-    """Contiguous rank block for one agent (world_size must divide evenly
-    — heterogeneous hosts are a follow-up, not a silent remainder)."""
-    if world_size % num_agents:
-        raise ValueError(
-            f"world_size {world_size} not divisible by {num_agents} agents"
-        )
-    per = world_size // num_agents
-    return list(range(agent_id * per, (agent_id + 1) * per))
+    """Contiguous rank block for one agent (see :func:`assign_ranks`)."""
+    return assign_ranks(world_size, num_agents)[agent_id]
 
 
 def _free_port() -> int:
@@ -146,6 +176,11 @@ class AgentConfig:
     backoff: float = 1.0
     backoff_max: float = 30.0
     verbose: bool = True
+    # Multi-tenancy: which job's KV namespace this agent lives in. The
+    # default job keeps the historical bare key schema; any other id puts
+    # every key (election, budgets, generations, heartbeats, fault claims)
+    # under job/<id>/ so concurrent jobs on one store cannot collide.
+    job_id: str = ""
 
     @property
     def local_ranks(self) -> list[int]:
@@ -199,6 +234,7 @@ class HostAgent:
             term_timeout=config.term_timeout, kill_on_parent_death=True
         )
         self._spawned_gen = 0
+        self._spawned_ranks: list[int] = list(config.local_ranks)
         self._reported_gen = 0
         self._acked_teardown_gen = 0
         self._partition_until = 0.0
@@ -221,7 +257,10 @@ class HostAgent:
 
     def run(self) -> int:
         cfg = self.cfg
-        self.kv = KVClient(cfg.kv_host, cfg.kv_port)
+        # All of this agent's KV traffic — election included — goes through
+        # the job-scoped view, so two jobs sharing one store elect separate
+        # leaders, charge separate budgets, and sweep separate namespaces.
+        self.kv = for_job(KVClient(cfg.kv_host, cfg.kv_port), cfg.job_id)
         self.election = LeaseElection(
             self.kv, self.aid, ttl=cfg.lease_ttl, prefix="leader"
         )
@@ -335,6 +374,21 @@ class HostAgent:
         raw = self.kv.try_get(K_GENERATION)
         return 0 if raw is None else int(raw)
 
+    def _gen_assignment(self, gen: int) -> dict[int, list[int]]:
+        """The generation's rank-assignment table, read from the launch
+        record the leader published. Pre-table records (or a record that
+        hasn't landed yet) fall back to the config-derived split — both
+        sides compute :func:`assign_ranks` deterministically, so the
+        fallback agrees with what the table would have said."""
+        raw = self.kv.try_get(k_launch(gen))
+        if raw is not None:
+            table = json.loads(raw).get("assign")
+            if table:
+                return {int(a): [int(r) for r in rs]
+                        for a, rs in table.items()}
+        blocks = assign_ranks(self.cfg.world_size, self.cfg.num_agents)
+        return dict(enumerate(blocks))
+
     def _agent_tick(self) -> None:
         gen = self._current_gen()
         if gen == 0:
@@ -373,6 +427,7 @@ class HostAgent:
 
     def _maybe_spawn(self, gen: int) -> None:
         cfg = self.cfg
+        ranks = self._gen_assignment(gen).get(self.aid, cfg.local_ranks)
         if self.kv.try_get(k_launch_ack(gen, self.aid)) is not None:
             # a previous incarnation of this agent acked this generation and
             # died; pdeathsig killed its ranks with it. Report the loss so
@@ -380,11 +435,11 @@ class HostAgent:
             # timeout on ranks that will never speak again.
             if (self._reported_gen != gen
                     and self.kv.try_get(k_report(gen, self.aid)) is None):
-                self._report(gen, "failure", {}, cfg.local_ranks,
+                self._report(gen, "failure", {}, ranks,
                              note="agent restarted; local ranks lost")
             self._reported_gen = gen
             return
-        if 0 in cfg.local_ranks:
+        if 0 in ranks:
             # rank 0 lives here: its host picks the jax.distributed
             # coordinator port (must be free on THIS machine) and publishes
             # it for everyone
@@ -400,20 +455,22 @@ class HostAgent:
         env[ENV_KV_PORT] = str(cfg.kv_port)
         env[ENV_GENERATION] = str(gen)
         env[ENV_AGENT_ID] = str(self.aid)
+        env[ENV_JOB_ID] = cfg.job_id or DEFAULT_JOB
         cmds = [
-            list(self.rank_commands(gen, r, port)) for r in cfg.local_ranks
+            list(self.rank_commands(gen, r, port)) for r in ranks
         ]
         self.group.spawn(cmds, env)
         self._spawned_gen = gen
+        self._spawned_ranks = list(ranks)
         self._reported_gen = 0
         self.kv.set(k_launch_ack(gen, self.aid), b"1")
-        self._log(f"gen {gen}: spawned local rank(s) {cfg.local_ranks}")
+        self._log(f"gen {gen}: spawned local rank(s) {ranks}")
 
     def _monitor_local(self, gen: int) -> None:
         if self._reported_gen == gen:
             return
         codes = self.group.poll()
-        ranks = self.cfg.local_ranks
+        ranks = self._spawned_ranks
         culprits = [r for r, c in zip(ranks, codes) if c not in (None, 0)]
         if culprits:
             # initiator-only classification (same rule as the Supervisor):
@@ -493,16 +550,23 @@ class HostAgent:
         st = self._leader_state
         self._reset_health_plane()
         self.kv.delete(k_coordinator(gen))
+        blocks = assign_ranks(self.cfg.world_size, self.cfg.num_agents)
+        # the rank-assignment table rides in the launch record: agents
+        # spawn exactly the ranks the leader assigned them, so world sizes
+        # that don't divide by the host count gang-schedule cleanly
         self.kv.set(
             k_launch(gen),
-            json.dumps({"world_size": self.cfg.world_size, "at_gen": gen}),
+            json.dumps({
+                "world_size": self.cfg.world_size, "at_gen": gen,
+                "assign": {str(a): rs for a, rs in enumerate(blocks)},
+            }),
         )
         st.rank_watchdog = st.agent_watchdog = None  # fresh grace per gen
         self._ensure_watchdogs(st)
+        sizes = "+".join(str(len(b)) for b in blocks)
         self._log(
             f"gen {gen}: launch posted "
-            f"({self.cfg.num_agents} host(s) x "
-            f"{self.cfg.world_size // self.cfg.num_agents} rank(s))"
+            f"({self.cfg.num_agents} host(s), rank split {sizes})"
         )
 
     def _ensure_watchdogs(self, st: _LeaderState) -> None:
@@ -551,9 +615,8 @@ class HostAgent:
         # exit code in its agent's report instead). Ranks of agents that
         # already reported are done, not wedged.
         owner = {
-            r: a for a in range(self.cfg.num_agents)
-            for r in ranks_for_agent(a, self.cfg.num_agents,
-                                     self.cfg.world_size)
+            r: a for a, ranks in self._gen_assignment(gen).items()
+            for r in ranks
         }
         health = st.rank_watchdog.check()
         wedged = [
@@ -717,12 +780,15 @@ class HostAgent:
 
 
 class AgentLauncher:
-    """Single-machine stand-in for the cluster scheduler: owns the KV
-    server, spawns one agent process per simulated host, and replaces any
-    agent that dies before the job's terminal verdict (a real scheduler
-    rescheduling a lost host). The launcher has NO job knowledge — all
-    coordination lives in the agents; killing the launcher's children in
-    any order must never deadlock the job.
+    """Single-machine, single-job agent runner: owns the KV server, spawns
+    one agent process per simulated host, and replaces any agent that dies
+    before the job's terminal verdict (a real scheduler rescheduling a
+    lost host). The launcher has NO job knowledge — all coordination lives
+    in the agents; killing the launcher's children in any order must never
+    deadlock the job. The multi-job promotion of this class is
+    :class:`tpu_sandbox.runtime.scheduler.ClusterScheduler` (durable
+    queue, gang scheduling, priority preemption); this one stays as the
+    zero-ceremony path for one job on one machine.
 
     ``agent_command(agent_id, kv_port) -> argv`` builds one agent process's
     command line.

@@ -26,6 +26,26 @@ def _env_token() -> str | None:
     return os.environ.get("TPU_SANDBOX_KV_TOKEN") or None
 
 
+def _backoff_delays(timeout: float, *, base: float = 0.02, cap: float = 0.5):
+    """Jittered exponential backoff delays, exhausted at a hard deadline.
+
+    Yields the next sleep until ``timeout`` seconds (monotonic) have
+    elapsed since the first ``next()``; the generator then ends, which is
+    the caller's signal to give up. Each delay is the exponential envelope
+    scaled by a uniform factor in [0.5, 1.5) — when an elastic restart
+    relaunches a whole gang at once, unjittered clients hammer the
+    listening socket in lockstep — and the final sleep is clamped so no
+    caller oversleeps its own deadline."""
+    deadline = time.monotonic() + timeout
+    delay = base
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        yield min(delay * (0.5 + random.random()), remaining)
+        delay = min(delay * 2, cap)
+
+
 def _lib() -> ctypes.CDLL:
     global _cached
     try:
@@ -133,19 +153,18 @@ class KVClient:
         self.host, self.port = host, port
         self.token = token
         self.connect_timeout = connect_timeout
-        deadline = time.monotonic() + connect_timeout
-        delay = 0.02
+        retries = _backoff_delays(connect_timeout)
         while True:
             self._fd = self._lib.kv_connect(host.encode(), port)
             if self._fd >= 0:
                 break
-            if time.monotonic() >= deadline:
+            delay = next(retries, None)
+            if delay is None:
                 raise ConnectionError(
                     f"kv_connect {host}:{port} failed "
                     f"(retried for {connect_timeout}s)"
                 )
             time.sleep(delay)
-            delay = min(delay * 2, 0.5)
         self._hello()
         # one request-response in flight per connection: the wire protocol is
         # length-prefixed with no framing recovery, so concurrent callers
@@ -184,19 +203,18 @@ class KVClient:
         if self._fd >= 0:
             self._lib.kv_close(self._fd)
             self._fd = -1
-        deadline = time.monotonic() + max(self.connect_timeout, 1.0)
-        delay = 0.02
+        retries = _backoff_delays(max(self.connect_timeout, 1.0))
         while True:
             self._fd = self._lib.kv_connect(self.host.encode(), self.port)
             if self._fd >= 0:
                 self._hello()
                 return
-            if time.monotonic() >= deadline:
+            delay = next(retries, None)
+            if delay is None:
                 raise ConnectionError(
                     f"kv reconnect {self.host}:{self.port} failed"
                 )
             time.sleep(delay)
-            delay = min(delay * 2, 0.5)
 
     def _request(
         self, op: str, key: str, val: bytes = b"", cap: int = 1 << 20
@@ -293,6 +311,134 @@ class KVClient:
         if self._fd >= 0:
             self._lib.kv_close(self._fd)
             self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-job namespacing
+# ---------------------------------------------------------------------------
+
+ENV_JOB_ID = "TPU_SANDBOX_JOB_ID"
+
+# The "default job" (empty/absent/"default" job id) maps to the empty
+# namespace: its keys are the historical bare forms (leader/*, budget/*,
+# gen/*, job/done), so every single-job deployment — and every pre-cluster
+# test — keeps its exact KV schema.
+DEFAULT_JOB = "default"
+
+
+def job_namespace(job_id: str | None) -> str:
+    """The key prefix a job's runtime keys live under.
+
+    Empty string for the default job (bare-prefix backward-compat alias);
+    ``job/<id>/`` otherwise. Job ids may not contain '/' or whitespace —
+    namespace sweeps (``delete_prefix("job/<id>/")``) must never be able
+    to reach into a sibling job's keys via a crafted id."""
+    if not job_id or job_id == DEFAULT_JOB:
+        return ""
+    if any(c in job_id for c in "/ \t\n\r"):
+        raise ValueError(f"invalid job id {job_id!r}: '/' and whitespace "
+                         "are reserved (namespace sweeps must stay scoped)")
+    return f"job/{job_id}/"
+
+
+def for_job(kv: "KVClient | NamespacedKV", job_id: str | None):
+    """A view of ``kv`` scoped to one job's namespace.
+
+    The default job gets the client back unchanged (bitwise-identical key
+    schema to the pre-cluster runtime); any other id gets a
+    ``NamespacedKV`` that prepends ``job/<id>/`` to every key. Layering a
+    namespace on an already-namespaced view is a programming error."""
+    ns = job_namespace(job_id)
+    if not ns:
+        return kv
+    if isinstance(kv, NamespacedKV):
+        raise ValueError("refusing to nest job namespaces: "
+                         f"{kv.prefix!r} + {ns!r}")
+    return NamespacedKV(kv, ns)
+
+
+class NamespacedKV:
+    """A KVClient view that prepends a fixed prefix to every key.
+
+    This is the whole multi-tenant isolation story at the storage layer:
+    two jobs sharing one store each hold a view under ``job/<id>/``, so
+    their elections, budgets, generations, heartbeats, and fault claims
+    land in disjoint key ranges — no coordination code above this layer
+    needs to know other jobs exist. ``keys()`` strips the prefix on the
+    way out so callers see the same relative names they wrote."""
+
+    def __init__(self, client: KVClient, prefix: str):
+        if not prefix:
+            raise ValueError("NamespacedKV needs a non-empty prefix "
+                             "(use the raw client for the default job)")
+        self._kv = client
+        self.prefix = prefix
+
+    @property
+    def host(self) -> str:
+        return self._kv.host
+
+    @property
+    def port(self) -> int:
+        return self._kv.port
+
+    @property
+    def token(self) -> str | None:
+        return self._kv.token
+
+    @property
+    def raw(self) -> KVClient:
+        """The underlying un-namespaced client (cluster-level callers
+        only — e.g. the scheduler reading its own sched/* plane while
+        holding a job view)."""
+        return self._kv
+
+    def set(self, key: str, value: bytes | str) -> None:
+        self._kv.set(self.prefix + key, value)
+
+    def set_ttl(self, key: str, value: bytes | str, ttl: float) -> None:
+        self._kv.set_ttl(self.prefix + key, value, ttl)
+
+    def get(self, key: str) -> bytes:
+        return self._kv.get(self.prefix + key)
+
+    def try_get(self, key: str) -> bytes | None:
+        return self._kv.try_get(self.prefix + key)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return self._kv.add(self.prefix + key, delta)
+
+    def delete(self, key: str) -> None:
+        self._kv.delete(self.prefix + key)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        full = self._kv.keys(self.prefix + prefix)
+        return [k[len(self.prefix):] for k in full]
+
+    def delete_prefix(self, prefix: str = "") -> int:
+        # Empty relative prefix is legal here — it means "sweep my whole
+        # namespace", which is exactly the scoped cleanup the scheduler
+        # runs when a job ends; the store-wide wipe stays impossible
+        # because self.prefix is never empty.
+        return self._kv.delete_prefix(self.prefix + prefix)
+
+    def barrier(self, world_size: int, key: str = "barrier") -> None:
+        arrived = self.add(f"{key}/count", 1)
+        if arrived == world_size:
+            self.set(f"{key}/done", b"1")
+        self.get(f"{key}/done")
+
+    def clone(self) -> "NamespacedKV":
+        return NamespacedKV(self._kv.clone(), self.prefix)
+
+    def close(self) -> None:
+        self._kv.close()
 
     def __enter__(self):
         return self

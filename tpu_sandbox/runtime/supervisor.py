@@ -36,7 +36,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Sequence
 
-from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+from tpu_sandbox.runtime.kvstore import (
+    DEFAULT_JOB,
+    ENV_JOB_ID,
+    KVClient,
+    KVServer,
+    for_job,
+)
 from tpu_sandbox.runtime.watchdog import Watchdog, _hb_key
 
 #: Exit code meaning "I was preempted: state is saved, restart me for free".
@@ -212,10 +218,12 @@ class Supervisor:
         extra_env: Mapping[str, str] | None = None,
         kv_server: KVServer | None = None,
         verbose: bool = True,
+        job_id: str = "",
     ):
         if world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {world_size}")
         self.world_size = world_size
+        self.job_id = job_id
         self.command_for_generation = command_for_generation
         self.max_restarts = max_restarts
         self.max_preemptions = max_preemptions
@@ -292,6 +300,7 @@ class Supervisor:
         env.update(self.extra_env)
         env[ENV_KV_PORT] = str(kv_port)
         env[ENV_GENERATION] = str(gen)
+        env[ENV_JOB_ID] = self.job_id or DEFAULT_JOB
         start = time.monotonic()
         self._group.spawn(cmds, env)
         watchdog = Watchdog(
@@ -351,7 +360,9 @@ class Supervisor:
     def run(self) -> ElasticResult:
         result = ElasticResult(self.world_size)
         server = self._kv_server or KVServer()
-        kv = KVClient(port=server.port)
+        # job-scoped view: a shared external store can host several
+        # supervised jobs whose health/budget/fault keys never collide
+        kv = for_job(KVClient(port=server.port), self.job_id)
         self._reset_job_plane(kv)
         prev_handler = self._install_forwarder()
         gen = 0

@@ -51,17 +51,21 @@ PREEMPT_KEY = "preempt/requested"
 #: rule as PREEMPTED_EXIT_CODE above).
 ENV_GENERATION = "TPU_SANDBOX_GENERATION"
 ENV_AGENT_ID = "TPU_SANDBOX_AGENT_ID"
+ENV_JOB_ID = "TPU_SANDBOX_JOB_ID"
 
 
 @dataclass(frozen=True)
 class ElasticEnv:
     """The elastic identity a rank inherits from whoever spawned it:
     which relaunch generation this process belongs to (stamps checkpoints
-    and KV claims) and which host agent owns it (``None`` outside the
-    cross-host agent topology — e.g. under the single-host Supervisor)."""
+    and KV claims), which host agent owns it (``None`` outside the
+    cross-host agent topology — e.g. under the single-host Supervisor),
+    and which job's KV namespace it coordinates in (empty string = the
+    default job, bare key schema; see ``runtime.kvstore.for_job``)."""
 
     generation: str
     agent_id: int | None
+    job_id: str = ""
 
     @classmethod
     def from_env(cls, environ=None) -> "ElasticEnv":
@@ -70,6 +74,7 @@ class ElasticEnv:
         return cls(
             generation=env.get(ENV_GENERATION, "1"),
             agent_id=int(raw) if raw else None,
+            job_id=env.get(ENV_JOB_ID, ""),
         )
 
 

@@ -111,7 +111,23 @@ def add_elastic_cli(parser) -> None:
                              "agents (runtime/host_agent.py) coordinate "
                              "generations over the KV store with leader "
                              "election; 0 keeps the single-host supervisor. "
-                             "World size must divide by N")
+                             "World size need not divide by N (the leader "
+                             "publishes a balanced rank-assignment table)")
+    parser.add_argument("--job-id", type=str, default="", metavar="ID",
+                        help="with --elastic: run under this job's KV "
+                             "namespace (job/<ID>/...) so several jobs can "
+                             "share one store without colliding; empty = "
+                             "the bare default-job namespace")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="with --pool: this job's scheduling priority "
+                             "(higher wins; may preempt lower-priority "
+                             "running jobs)")
+    parser.add_argument("--pool", type=int, default=0, metavar="SLOTS",
+                        help="with --elastic: multi-tenant cluster mode — "
+                             "run runtime/scheduler.py over SLOTS host "
+                             "slots and gang-schedule the demo job(s) "
+                             "through its durable queue instead of "
+                             "launching agents directly")
     parser.add_argument("--agent-id", type=int, default=None, metavar="ID",
                         help="run exactly ONE host agent (0..N-1) of an "
                              "--agents N job and exit with its verdict — "
