@@ -32,6 +32,7 @@ except AttributeError:
 jax.config.update("jax_threefry_partitionable", True)
 
 import gc  # noqa: E402
+import sys  # noqa: E402
 import threading  # noqa: E402
 import time  # noqa: E402
 
@@ -73,6 +74,11 @@ def _no_resource_leaks():
     this pins the blame at the source. Daemon threads get a pass (wedged
     Heartbeat threads are abandoned by design), and stragglers get a short
     join grace first so tests that are merely slow to wind down don't trip.
+
+    Serve engines count too: an engine still holding admitted or queued
+    requests after a test means the test abandoned in-flight work (the
+    replica drain/requeue paths exist precisely so nothing is ever
+    abandoned), so it fails the same way a leaked server does.
     """
     from tpu_sandbox.runtime import kvstore
 
@@ -96,6 +102,18 @@ def _no_resource_leaks():
     leaked_servers = [s for s in kvstore.live_servers()
                       if s not in servers_before]
     problems = []
+    if "tpu_sandbox.serve.engine" in sys.modules:
+        from tpu_sandbox.serve.engine import live_engines
+
+        busy = live_engines()
+        if busy:
+            loads = [(e.active_requests, len(e.waiting)) for e in busy]
+            for e in busy:  # unwedge the rest of the session
+                e.drain_to_requests()
+            problems.append(
+                f"{len(busy)} serve engine(s) abandoned with in-flight "
+                f"work (active, waiting): {loads}"
+            )
     if leaked_servers:
         ports = [s.port for s in leaked_servers]
         for s in leaked_servers:  # free the ports for the rest of the run

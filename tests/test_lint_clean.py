@@ -268,14 +268,17 @@ def _run_graftlint(*extra):
 
 def test_graftlint_cli_traces_all_steps():
     """Tier-1 half of the CLI gate: all three passes, jaxpr-tracing the
-    real DP/ZeRO/pjit/pipeline steps on CPU. The AOT compiles are skipped
-    here (`--no-aot`) to keep tier-1 inside its time budget — the full
-    chipless AOT receipt runs in the slow twin below."""
+    real DP/ZeRO/pjit/pipeline steps — plus the engine-flag variants
+    (int8 grad compress, bucketed overlap), SeqParallel, and the serve
+    decode step — on CPU. The AOT compiles are skipped here (`--no-aot`)
+    to keep tier-1 inside its time budget — the full chipless AOT receipt
+    runs in the slow twin below."""
     report = _run_graftlint("--no-aot")
     assert report["findings"] == 0
     assert report["unused_suppressions"] == 0
     hlo = report["hlo"]
-    for step in ("dp", "zero", "pjit", "pipeline"):
+    for step in ("dp", "zero", "pjit", "pipeline", "dp-int8",
+                 "dp-overlap", "sp", "decode"):
         assert hlo[step]["status"] == "traced", hlo
 
 

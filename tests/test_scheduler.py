@@ -309,6 +309,116 @@ def test_priority_preemption_checkpoints_and_resumes(agent_script):
         assert verdict["ok"] and verdict["restarts"] == 0
 
 
+# -- backfill --------------------------------------------------------------
+
+
+def _tick_until(sched, pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sched._tick()
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_backfill_runs_small_job_behind_blocked_head(agent_script):
+    """Pool 3: an equal-priority 2-host head can't preempt the 2-host
+    occupant and can't fit the 1 free slot — a strictly-lower-priority
+    1-host job may start behind it (and everything still finishes)."""
+    with ClusterScheduler(3, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(job_id="occupant", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.6)))
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state("occupant")) == b"running"))
+        sched.submit(JobSpec(job_id="head", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        sched.submit(JobSpec(job_id="small", hosts=1, world_size=1,
+                             priority=0,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state("small")) == b"running"))
+        # the head is still blocked and queued — small jumped it, safely
+        assert sched.kv.try_get(k_state("head")) == b"queued"
+        assert "backfilled" in job_events(sched.kv, "small")
+        states = sched.serve(timeout=60)
+        assert states == {"occupant": "done", "head": "done",
+                          "small": "done"}, states
+
+
+@pytest.mark.slow  # ~4s of real agent work; tier-1 keeps the positive case
+def test_backfill_never_admits_equal_priority(agent_script):
+    """An equal-priority candidate could starve the head (the head can't
+    preempt it back out), so it must wait in line even when it fits."""
+    with ClusterScheduler(3, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(job_id="occupant", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.6)))
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state("occupant")) == b"running"))
+        sched.submit(JobSpec(job_id="head", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        sched.submit(JobSpec(job_id="peer", hosts=1, world_size=1,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        for _ in range(10):
+            sched._tick()
+            time.sleep(0.02)
+        assert sched.kv.try_get(k_state("peer")) == b"queued"
+        assert sched.kv.keys("job/peer/test/ran/") == []
+        assert "backfilled" not in job_events(sched.kv, "peer")
+        states = sched.serve(timeout=60)
+        assert states == {"occupant": "done", "head": "done",
+                          "peer": "done"}, states
+        # FIFO held: the head went first once the occupant's slots freed
+        assert job_events(sched.kv, "head")["admitted"] <= \
+            job_events(sched.kv, "peer")["admitted"]
+
+
+@pytest.mark.slow  # ~4s of real agent work; tier-1 keeps the positive case
+def test_backfill_starvation_guard_near_head_deadline(agent_script):
+    """Once the head has burned half its admission window, backfill stops
+    — the remaining window is reserved for making room."""
+    with ClusterScheduler(3, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(job_id="occupant", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.6)))
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state("occupant")) == b"running"))
+        sched.submit(JobSpec(job_id="head", hosts=2, world_size=2,
+                             priority=5,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        sched._tick()  # registers the head's admission deadline
+        # simulate the head having consumed ~75% of its 120s window
+        sched._queue_deadline["head"] = time.monotonic() + 30.0
+        sched.submit(JobSpec(job_id="late", hosts=1, world_size=1,
+                             priority=0,
+                             agent_argv=_agent_argv(agent_script, "work",
+                                                    0.2)))
+        for _ in range(10):
+            sched._tick()
+            time.sleep(0.02)
+        assert sched.kv.try_get(k_state("late")) == b"queued"
+        assert "backfilled" not in job_events(sched.kv, "late")
+        states = sched.serve(timeout=60)
+        assert states == {"occupant": "done", "head": "done",
+                          "late": "done"}, states
+
+
 # -- admission deadline + sweep --------------------------------------------
 
 

@@ -72,7 +72,9 @@ def main(argv=None) -> int:
     p.add_argument("--no-aot", action="store_true",
                    help="pass 2 without the chipless AOT compiles "
                         "(donation reported as skipped)")
-    p.add_argument("--steps", default="dp,zero,pjit,pipeline",
+    p.add_argument("--steps",
+                   default="dp,zero,pjit,pipeline,dp-int8,dp-overlap,"
+                           "sp,decode",
                    help="pass 2 step functions to trace")
     args = p.parse_args(argv)
 

@@ -7,8 +7,10 @@ Two layers, split so the cheap one is always available:
   and emit findings. They import nothing heavy — the fixture tests drive
   them directly.
 - **The driver** (:func:`run_hlo_pass`) builds the real engines chipless
-  and feeds them through: it traces ``DataParallel`` (plain + ZeRO),
-  ``PjitEngine``, and ``PipelineParallel`` steps to jaxprs on CPU
+  and feeds them through: it traces ``DataParallel`` (plain, ZeRO, and
+  the int8-grad-compress / bucketed-overlap flag variants),
+  ``PjitEngine``, ``PipelineParallel``, ``SeqParallel``, and the serve
+  decode step to jaxprs on CPU
   devices, then AOT-compiles the DP/ZeRO steps against a multi-chip v5e
   topology (``tools/aot_v5e.make_topology``) to verify input donation
   from XLA's own ``memory_analysis`` and to check the overlapped
@@ -288,6 +290,51 @@ def _trace_targets(steps) -> tuple[list[Finding], dict]:
         )
         toks = jax.ShapeDtypeStruct((4, 64), jnp.int32)
         trace("pipeline", pp._compile_for(pstate), pstate, toks, toks)
+    # engine-flag variants: the same DP step graph is a different graph
+    # under grad compression / bucketed overlap, and each has had its own
+    # regression history — lint them as first-class steps
+    if "dp-int8" in steps:
+        dpc = DataParallel(model, tx, mesh, grad_compress="int8")
+        trace("dp-int8", dpc._compile_for(state), state, imgs, labs)
+    if "dp-overlap" in steps:
+        dpo = DataParallel(model, tx, mesh, overlap_grad_sync=True)
+        trace("dp-overlap", dpo._compile_for(state), state, imgs, labs)
+    if "sp" in steps:
+        from tpu_sandbox.models.transformer import TransformerConfig
+        from tpu_sandbox.models.transformer import TransformerLM
+        from tpu_sandbox.parallel import SeqParallel
+
+        cfg_sp = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                   n_layers=2, d_ff=64, max_len=64)
+        mesh_sp = Mesh(devices.reshape(2, 4), ("data", "sp"))
+        sp = SeqParallel(
+            lambda attn: TransformerLM(cfg_sp, attention_fn=attn),
+            tx, mesh_sp)
+        sstate = jax.eval_shape(
+            sp.init_state, jax.random.key(0),
+            jnp.zeros((2, 64), jnp.int32),
+        )
+        stoks = jax.ShapeDtypeStruct((2, 64), jnp.int32)
+        trace("sp", sp._jitted, sstate, stoks, stoks, stoks)
+    if "decode" in steps:
+        from tpu_sandbox.models.transformer import TransformerConfig
+        from tpu_sandbox.models.transformer import TransformerLM
+        from tpu_sandbox.serve.cache import CacheConfig
+        from tpu_sandbox.serve.decode import make_decode_fn, page_shapes
+
+        cfg_d = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                  n_layers=2, d_ff=64, max_len=64)
+        ccfg = CacheConfig(num_blocks=16, block_size=8,
+                           max_blocks_per_seq=4)
+        dparams = jax.eval_shape(
+            lambda: TransformerLM(cfg_d).init(
+                jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+        kd, vd = page_shapes(cfg_d, ccfg, jnp.float32)
+        trace("decode", make_decode_fn(cfg_d, ccfg, 2),
+              dparams, kd, vd,
+              jax.ShapeDtypeStruct((2, 1), jnp.int32),
+              jax.ShapeDtypeStruct((2,), jnp.int32),
+              jax.ShapeDtypeStruct((2, ccfg.max_blocks_per_seq), jnp.int32))
     return findings, report
 
 
@@ -367,7 +414,8 @@ def _aot_targets(steps, *, topology: str, chips, overlap_check: bool,
 
 def run_hlo_pass(
     *,
-    steps=("dp", "zero", "pjit", "pipeline"),
+    steps=("dp", "zero", "pjit", "pipeline", "dp-int8", "dp-overlap",
+           "sp", "decode"),
     aot: bool = True,
     topology: str = "v5e:2x2x1",
     chips=(2, 2, 1),

@@ -74,6 +74,10 @@ class SelfAttention(nn.Module):
             (3, cfg.n_heads, head_dim), dtype=cfg.dtype, name="qkv"
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # Serving prefill taps per-layer K/V here. A no-op unless the caller
+        # passes mutable=["kv_cache"] (training never does), so the trained
+        # step graphs are untouched.
+        self.sow("kv_cache", "kv", (k, v), reduce_fn=lambda _, x: x)
         attn = self.attention_fn or (lambda q, k, v: causal_attention(q, k, v))
         out = attn(q, k, v)  # [B, S, H, D]
         return nn.DenseGeneral(

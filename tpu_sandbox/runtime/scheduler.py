@@ -255,10 +255,16 @@ class ClusterScheduler:
 
     - **Gang, all-or-nothing.** A job launches with its full ``hosts``
       gang or not at all; there is no partial admission, ever.
-    - **Strict priority, FIFO within a priority, no backfill.** Only the
-      head of the queue is considered each tick. A small job never jumps
-      a blocked bigger one — head-of-line blocking is the price of
-      starvation-freedom, and admission deadlines bound the damage.
+    - **Strict priority, FIFO within a priority, conservative backfill.**
+      The head of the queue is considered first each tick. When the head
+      is blocked with no room on the way (nothing preemptable, nothing
+      winding down), *strictly lower-priority* jobs that fit the free
+      slots may start behind it — strictly lower, so the head keeps
+      preemption rights over every backfilled gang and can only be
+      delayed by one preemption drain, never indefinitely. Once the head
+      has burned ``backfill_guard_frac`` of its admission window,
+      backfill stops: the remaining window is reserved for making room,
+      not for new tenants to churn through.
     - **Preemption frees exactly what's needed.** When the head job
       outranks running work, the lowest-priority victims (newest first)
       are SIGTERMed until enough slots will free. Victims checkpoint via
@@ -285,6 +291,7 @@ class ClusterScheduler:
         respawn_limit: int = 16,
         preempt_kill_timeout: float = 120.0,
         adopt_timeout: float = 15.0,
+        backfill_guard_frac: float = 0.5,
         extra_env: Mapping[str, str] | None = None,
         verbose: bool = True,
     ):
@@ -304,6 +311,11 @@ class ClusterScheduler:
         self.respawn_limit = respawn_limit
         self.preempt_kill_timeout = preempt_kill_timeout
         self.adopt_timeout = adopt_timeout
+        if not 0.0 <= backfill_guard_frac <= 1.0:
+            raise ValueError(
+                f"backfill_guard_frac must be in [0, 1], got "
+                f"{backfill_guard_frac}")
+        self.backfill_guard_frac = backfill_guard_frac
         self.extra_env = dict(extra_env or {})
         self.verbose = verbose
         self.kv: KVClient | None = None
@@ -633,6 +645,52 @@ class ClusterScheduler:
                     f"{spec.job_id!r} (priority {spec.priority})"
                 )
                 self._terminate_gang(victim)
+            return
+        self._try_backfill(order, spec, free)
+
+    def _try_backfill(self, order: list[dict], head_spec: JobSpec,
+                      free: int) -> None:
+        """The head is blocked and no preemption can help it. Strictly
+        lower-priority queued jobs that fit the free slots may start
+        behind it: strictly lower keeps the head's preemption rights over
+        every backfilled gang, so backfill can delay the head by at most
+        one preemption drain — never indefinitely. The starvation guard
+        stops backfilling once the head has consumed
+        ``backfill_guard_frac`` of its admission window, reserving the
+        rest of the window for room to appear rather than churn."""
+        if free < 1 or len(order) < 2:
+            return
+        pending = sum(
+            j.spec.hosts for j in self._running.values()
+            if j.preempting or j.cancelling
+        )
+        if free + pending >= head_spec.hosts:
+            return  # the head's room is already on its way: don't take it
+        dl = self._queue_deadline.get(head_spec.job_id)
+        if dl is not None and dl - time.monotonic() <= (
+                (1.0 - self.backfill_guard_frac)
+                * head_spec.admission_timeout):
+            return  # head too close to its deadline; stop churning
+        for entry in order[1:]:
+            if free < 1:
+                return
+            if entry["priority"] >= head_spec.priority:
+                continue  # the head couldn't preempt it back out: skip
+            raw = self.kv.try_get(k_spec(entry["job_id"]))
+            if raw is None:
+                continue
+            cand = JobSpec.from_json(raw.decode())
+            if cand.hosts > free:
+                continue
+            self.kv.set(k_event(cand.job_id, "backfilled"),
+                        f"{time.time():.6f}")
+            self._log(
+                f"backfilling job {cand.job_id!r} (priority "
+                f"{cand.priority}, {cand.hosts} host(s)) behind blocked "
+                f"head {head_spec.job_id!r} (priority {head_spec.priority})"
+            )
+            self._admit(cand, entry["seq"])
+            free = self._slots_free()
 
     def _pick_victims(self, spec: JobSpec, free: int) -> list[_RunningJob]:
         """Lowest priority first, newest first within a priority; only

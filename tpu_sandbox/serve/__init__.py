@@ -1,0 +1,38 @@
+"""Inference serving stack: AOT decode, paged KV cache, continuous batching.
+
+Layers (bottom up):
+
+- ``cache``   — host-side paged KV-cache allocator: fixed-size blocks, per-
+  sequence block tables, free-list reuse, refcounted prefix sharing.
+- ``decode``  — AOT-compiled static-shape prefill (bucketed lengths) and
+  single-token decode step for ``models/transformer.py``, both donating the
+  device page buffers.
+- ``engine``  — continuous-batching engine: admits/evicts sequences at
+  decode-step granularity, preempts-to-requeue under block pressure, plus a
+  static-batch baseline for the bench comparison.
+- ``replica`` — replica processes behind the KV-backed request queue:
+  claim-once queue entries, TTL leases, idempotent results, SIGTERM drain
+  back to the queue, orphan scavenging. Replicas run as ranks of a
+  HostAgent gang so the elastic runtime relaunches them.
+"""
+
+from tpu_sandbox.serve.cache import CacheConfig, PagedKVCache
+from tpu_sandbox.serve.engine import (
+    ContinuousEngine,
+    Request,
+    RequestResult,
+    ServeConfig,
+    StaticEngine,
+    live_engines,
+)
+
+__all__ = [
+    "CacheConfig",
+    "PagedKVCache",
+    "ContinuousEngine",
+    "Request",
+    "RequestResult",
+    "ServeConfig",
+    "StaticEngine",
+    "live_engines",
+]

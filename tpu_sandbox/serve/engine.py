@@ -1,0 +1,291 @@
+"""Continuous-batching serve engine (plus the static-batch baseline).
+
+``ContinuousEngine`` owns the compiled steps, the page buffers, and the
+paged allocator, and advances the whole replica one decode step at a time:
+
+- **admit at step granularity** — every step first fills free decode slots
+  from the waiting queue (prefill runs per request, one compiled bucket);
+- **evict at step granularity** — sequences that finish release their
+  blocks immediately, and the freed slots/blocks are available to the very
+  next admit, no batch barrier;
+- **preempt-to-requeue** — when a sequence crosses a block boundary and no
+  block can be allocated, the newest-admitted sequence is evicted and its
+  request goes back to the waiting queue intact (greedy decode + bitwise
+  steps make the replay identical).
+
+``StaticEngine`` is the control: admit a full batch, decode until *all* of
+it finishes, then admit the next batch. Same compiled steps, same
+allocator — the bench compares scheduling policy only.
+
+Decoding is greedy argmax over fp32 logits — deterministic, which is what
+makes requeue/replay and the replica zero-loss story exact rather than
+probabilistic.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sandbox.models.transformer import TransformerConfig
+from tpu_sandbox.serve.cache import CacheConfig, PagedKVCache, SeqAlloc
+from tpu_sandbox.serve.decode import DecodeStep, build_decode_step, init_pages
+
+# engines with a live decode loop / replica thread, for the conftest leak
+# fixture (mirrors kvstore.live_servers())
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_engines() -> list:
+    return [e for e in _LIVE_ENGINES if e.active_requests or e.waiting]
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    model: TransformerConfig = field(default_factory=TransformerConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    max_batch: int = 4
+    buckets: tuple[int, ...] = (16, 32, 64)
+    cache_dtype: Any = jnp.float32
+    eos_token: int | None = None  # None -> run to max_new_tokens
+
+
+@dataclass
+class Request:
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    arrival: float = 0.0  # engine clock time the request became visible
+    preemptions: int = 0  # times evicted-to-requeue so far
+
+
+@dataclass
+class RequestResult:
+    rid: str
+    tokens: list[int]             # generated tokens only
+    ttft: float                   # first-token latency (s, engine clock)
+    itl: list[float]              # inter-token latencies (s)
+    finished_at: float = 0.0
+    preemptions: int = 0
+
+
+@dataclass
+class _Slot:
+    request: Request
+    alloc: SeqAlloc
+    tokens: list[int]             # prompt + generated
+    generated: list[int] = field(default_factory=list)
+    first_token_at: float | None = None
+    last_token_at: float | None = None
+    itl: list[float] = field(default_factory=list)
+    preemptions: int = 0
+
+
+class _EngineBase:
+    def __init__(self, params, config: ServeConfig,
+                 step: DecodeStep | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self.params = params
+        self.step_fns = step or build_decode_step(
+            config.model, config.cache, max_batch=config.max_batch,
+            buckets=config.buckets, cache_dtype=config.cache_dtype)
+        self.cache = PagedKVCache(config.cache)
+        self.k_pages, self.v_pages = init_pages(
+            config.model, config.cache, config.cache_dtype)
+        self.clock = clock
+        self.waiting: deque[Request] = deque()
+        self.slots: list[_Slot | None] = [None] * config.max_batch
+        self.results: dict[str, RequestResult] = {}
+        self.steps = 0
+        _LIVE_ENGINES.add(self)
+
+    # -- public --------------------------------------------------------------
+
+    @property
+    def active_requests(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def submit(self, request: Request) -> None:
+        if self.cache.blocks_needed(request.prompt, request.max_new_tokens) \
+                > self.config.cache.max_blocks_per_seq:
+            raise ValueError(f"request {request.rid} exceeds max context")
+        self.waiting.append(request)
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and self.active_requests == 0
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError("serve engine failed to drain")
+
+    def drain_to_requests(self) -> list[Request]:
+        """Evict everything in flight back to request form (original prompt,
+        arrival preserved) — the replica's SIGTERM path."""
+        out = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            self.cache.free(slot.alloc, cache_prefix=False)
+            out.append(slot.request)
+            self.slots[i] = None
+        out.extend(self.waiting)
+        self.waiting.clear()
+        return out
+
+    # -- shared mechanics ----------------------------------------------------
+
+    def _try_admit(self, request: Request) -> bool:
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        if not free:
+            return False
+        # reserve the prompt's blocks only; decode grows the allocation one
+        # block at a time, so block pressure shows up as preempt-to-requeue
+        # rather than refused admission
+        alloc = self.cache.alloc(request.prompt, 0)
+        if alloc is None:
+            return False
+        self._prefill(request, alloc, free[0])
+        return True
+
+    def _prefill(self, request: Request, alloc: SeqAlloc, slot_idx: int):
+        cfg = self.config
+        plen = len(request.prompt)
+        bucket = self.step_fns.pick_bucket(plen)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = request.prompt
+        dest = self.cache.dest_indices(alloc, bucket).astype(np.int32)
+        next_logits, self.k_pages, self.v_pages = self.step_fns.prefill[bucket](
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(toks), jnp.asarray(dest),
+            jnp.asarray(plen - 1, jnp.int32))
+        alloc.length = plen
+        self.cache.commit_prefix(alloc)
+        slot = _Slot(request=request, alloc=alloc, tokens=list(request.prompt),
+                     preemptions=request.preemptions)
+        self.slots[slot_idx] = slot
+        self._emit_token(slot, int(np.asarray(next_logits).argmax()))
+        if self._finished(slot):
+            self._retire(slot_idx)
+
+    def _emit_token(self, slot: _Slot, token: int) -> None:
+        now = self.clock()
+        if slot.first_token_at is None:
+            slot.first_token_at = now
+        elif slot.last_token_at is not None:
+            slot.itl.append(now - slot.last_token_at)
+        slot.last_token_at = now
+        slot.generated.append(token)
+        slot.tokens.append(token)
+
+    def _finished(self, slot: _Slot) -> bool:
+        if len(slot.generated) >= slot.request.max_new_tokens:
+            return True
+        eos = self.config.eos_token
+        return eos is not None and slot.generated and slot.generated[-1] == eos
+
+    def _retire(self, i: int) -> None:
+        slot = self.slots[i]
+        self.slots[i] = None
+        self.cache.free(slot.alloc)
+        req = slot.request
+        self.results[req.rid] = RequestResult(
+            rid=req.rid, tokens=list(slot.generated),
+            ttft=slot.first_token_at - req.arrival,
+            itl=list(slot.itl), finished_at=self.clock(),
+            preemptions=slot.preemptions)
+
+    def _preempt(self, i: int) -> None:
+        """Evict slot i back to the waiting queue (front: it has seniority)."""
+        slot = self.slots[i]
+        self.slots[i] = None
+        self.cache.free(slot.alloc, cache_prefix=False)
+        req = slot.request
+        req.preemptions = slot.preemptions + 1
+        self.waiting.appendleft(req)
+
+    def _ensure_capacity(self, i: int) -> bool:
+        """Grow slot i's allocation for its next token; on block pressure
+        preempt the newest other slot and retry. False = slot i itself must
+        be preempted (nothing left to evict)."""
+        slot = self.slots[i]
+        need_block = slot.alloc.length % self.config.cache.block_size == 0 \
+            and slot.alloc.length // self.config.cache.block_size \
+            >= len(slot.alloc.block_ids)
+        if not need_block:
+            return True
+        while not self.cache.grow(slot.alloc):
+            victims = [j for j, s in enumerate(self.slots)
+                       if s is not None and j != i]
+            if not victims:
+                return False
+            self._preempt(max(victims, key=lambda j: self.slots[j].alloc.seq_id))
+        return True
+
+    def _decode_active(self) -> None:
+        """One compiled decode step over every occupied slot."""
+        B = self.config.max_batch
+        cfg = self.config.cache
+        tokens = np.zeros((B, 1), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
+        # resolve capacity for every slot first: growing one slot may
+        # preempt another that was already swept, so the batch is built
+        # only from the survivors
+        for i in range(B):
+            if self.slots[i] is not None and not self._ensure_capacity(i):
+                self._preempt(i)
+        live = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            live.append(i)
+            tokens[i, 0] = slot.tokens[-1]
+            lengths[i] = len(slot.tokens)
+            tables[i] = self.cache.block_table(slot.alloc)
+        if not live:
+            return
+        logits, self.k_pages, self.v_pages = self.step_fns.decode(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables))
+        logits = np.asarray(logits)
+        self.steps += 1
+        for i in live:
+            slot = self.slots[i]
+            slot.alloc.length = len(slot.tokens)
+            self._emit_token(slot, int(logits[i].argmax()))
+            if self._finished(slot):
+                self._retire(i)
+
+
+class ContinuousEngine(_EngineBase):
+    """Admit/evict at decode-step granularity — freed slots refill before
+    the next step, nothing waits for a batch to finish."""
+
+    def step(self) -> None:
+        while self.waiting:
+            if not self._try_admit(self.waiting[0]):
+                break
+            self.waiting.popleft()
+        self._decode_active()
+
+
+class StaticEngine(_EngineBase):
+    """Batch-barrier control: fill the batch once, then decode until every
+    member finishes before admitting again."""
+
+    def step(self) -> None:
+        if self.active_requests == 0:
+            while self.waiting and self._try_admit(self.waiting[0]):
+                self.waiting.popleft()
+        self._decode_active()

@@ -1,0 +1,322 @@
+"""Serve replicas behind a KV-backed request queue — zero-loss by protocol.
+
+The KV store (the same one the elastic runtime coordinates through) holds
+the whole request plane:
+
+    serve/req/<rid>      request body  {rid, prompt, max_new_tokens}
+    serve/queue/tail     atomic entry counter (``add()``)
+    serve/queue/<n>      entry n -> rid  (requeues append fresh entries)
+    serve/claim/<n>      claim-once marker: first ``add()`` == 1 wins
+    serve/lease/<rid>    TTL heartbeat while a replica works the request
+    serve/scavenged/<n>  claim-once marker so an orphaned entry is
+                         requeued exactly once
+    serve/result/<rid>   result body — idempotent (greedy decode over
+                         bitwise-deterministic steps: every execution of a
+                         request writes identical bytes)
+    serve/total          number of distinct requests the producer will pose
+
+Loss cases and their answers:
+
+- **SIGTERM (drain path)** — the replica evicts every in-flight sequence
+  back to request form and appends fresh queue entries, then exits with
+  ``PREEMPTED_EXIT_CODE`` so the elastic budget treats it as preemption.
+- **SIGKILL (no goodbye)** — its claims stay but the leases expire;
+  any peer's scavenge pass requeues claimed-unleased-unresulted entries
+  (at most once per entry via ``serve/scavenged/<n>``).
+- **Double execution** — a slow-but-alive claimant racing a scavenged
+  duplicate wastes compute, never correctness: results are identical and
+  the write is idempotent.
+
+Replicas run as ranks of a HostAgent gang (one rank per replica), so a
+killed replica process triggers the standard generation teardown and
+relaunch — the elastic runtime is the autoscaler's restart loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass
+from typing import Sequence
+
+from tpu_sandbox.runtime.kvstore import KVClient
+from tpu_sandbox.runtime.supervisor import ENV_KV_PORT, PREEMPTED_EXIT_CODE
+
+K_TAIL = "serve/queue/tail"
+K_TOTAL = "serve/total"
+
+
+def k_req(rid: str) -> str:
+    return f"serve/req/{rid}"
+
+
+def k_queue(n: int) -> str:
+    return f"serve/queue/{n}"
+
+
+def k_claim(n: int) -> str:
+    return f"serve/claim/{n}"
+
+
+def k_lease(rid: str) -> str:
+    return f"serve/lease/{rid}"
+
+
+def k_scavenged(n: int) -> str:
+    return f"serve/scavenged/{n}"
+
+
+def k_result(rid: str) -> str:
+    return f"serve/result/{rid}"
+
+
+# -- producer side -----------------------------------------------------------
+
+
+def submit_request(kv, rid: str, prompt: Sequence[int],
+                   max_new_tokens: int) -> None:
+    kv.set(k_req(rid), json.dumps(
+        {"rid": rid, "prompt": list(map(int, prompt)),
+         "max_new_tokens": int(max_new_tokens)}))
+    enqueue(kv, rid)
+
+
+def enqueue(kv, rid: str) -> int:
+    n = kv.add(K_TAIL) - 1
+    kv.set(k_queue(n), rid)
+    return n
+
+
+def announce_total(kv, total: int) -> None:
+    kv.set(K_TOTAL, str(total))
+
+
+def results_done(kv) -> bool:
+    total = kv.try_get(K_TOTAL)
+    if total is None:
+        return False
+    return len(kv.keys("serve/result/")) >= int(total)
+
+
+def read_result(kv, rid: str, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        raw = kv.try_get(k_result(rid))
+        if raw is not None:
+            return json.loads(raw)
+        time.sleep(0.02)
+    raise TimeoutError(f"no result for {rid} within {timeout}s")
+
+
+# -- replica side ------------------------------------------------------------
+
+
+@dataclass
+class ReplicaStats:
+    claimed: int = 0
+    completed: int = 0
+    requeued: int = 0
+    scavenged: int = 0
+
+
+class ReplicaWorker:
+    """One replica: claims queue entries into a local engine, publishes
+    results, heartbeats leases, scavenges orphans. Pure poll loop — no
+    threads of its own, so it embeds cleanly in tests and in the worker
+    process main below."""
+
+    def __init__(self, kv: KVClient, engine, *, tag: str = "replica",
+                 lease_ttl: float = 3.0, claim_depth: int | None = None,
+                 scavenge_interval: float | None = None):
+        self.kv = kv
+        self.engine = engine
+        self.tag = tag
+        self.lease_ttl = lease_ttl
+        self.claim_depth = claim_depth or 2 * engine.config.max_batch
+        self.scavenge_interval = scavenge_interval or lease_ttl
+        self._scanned = 0
+        self._published: set[str] = set()
+        self._next_scavenge = time.monotonic() + self.scavenge_interval
+        self.stats = ReplicaStats()
+        self._draining = False
+
+    # one request currently inside the local engine per rid
+    def _local_load(self) -> int:
+        return self.engine.active_requests + len(self.engine.waiting)
+
+    def request_drain(self) -> None:
+        self._draining = True
+
+    def tick(self) -> bool:
+        """One poll-loop iteration. Returns False when all work is done
+        (or a drain was requested and completed)."""
+        from tpu_sandbox.serve.engine import Request
+
+        if self._draining:
+            self.drain()
+            return False
+        if results_done(self.kv):
+            return False
+        tail = int(self.kv.try_get(K_TAIL) or b"0")
+        while self._scanned < tail and self._local_load() < self.claim_depth:
+            n = self._scanned
+            self._scanned += 1
+            rid_raw = self.kv.try_get(k_queue(n))
+            if rid_raw is None:
+                continue  # tail bumped, entry body not written yet: revisit
+            rid = rid_raw.decode()
+            if self.kv.try_get(k_result(rid)) is not None:
+                continue
+            # lease before claim: a scavenger never sees a fresh claim
+            # without a heartbeat (spurious requeues would still be safe,
+            # just wasted work)
+            self.kv.set_ttl(k_lease(rid), self.tag, self.lease_ttl)
+            if self.kv.add(k_claim(n)) != 1:
+                continue
+            body = json.loads(self.kv.get(k_req(rid)))
+            self.engine.submit(Request(
+                rid=rid, prompt=body["prompt"],
+                max_new_tokens=body["max_new_tokens"],
+                arrival=self.engine.clock()))
+            self.stats.claimed += 1
+        if not self.engine.idle:
+            self.engine.step()
+        self._heartbeat()
+        self._publish_new()
+        if time.monotonic() >= self._next_scavenge:
+            self._next_scavenge = time.monotonic() + self.scavenge_interval
+            self.scavenge()
+        return True
+
+    def run(self, poll: float = 0.005, timeout: float = 300.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.tick():
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"replica {self.tag} timed out")
+            if self.engine.idle:
+                time.sleep(poll)
+
+    def drain(self) -> int:
+        """Requeue everything in flight; the SIGTERM path."""
+        requests = self.engine.drain_to_requests()
+        for req in requests:
+            if req.rid in self._published or \
+                    self.kv.try_get(k_result(req.rid)) is not None:
+                continue
+            enqueue(self.kv, req.rid)
+            self.kv.delete(k_lease(req.rid))
+            self.stats.requeued += 1
+        return self.stats.requeued
+
+    def scavenge(self) -> int:
+        """Requeue claimed entries whose worker went silent (no lease, no
+        result). Each entry is requeued at most once, by one scavenger."""
+        n_rescued = 0
+        tail = int(self.kv.try_get(K_TAIL) or b"0")
+        for n in range(tail):
+            if self.kv.try_get(k_claim(n)) is None:
+                continue
+            rid_raw = self.kv.try_get(k_queue(n))
+            if rid_raw is None:
+                continue
+            rid = rid_raw.decode()
+            if self.kv.try_get(k_result(rid)) is not None:
+                continue
+            if self.kv.try_get(k_lease(rid)) is not None:
+                continue  # someone is alive and working it
+            if self.kv.add(k_scavenged(n)) != 1:
+                continue  # another scavenger took this entry
+            enqueue(self.kv, rid)
+            n_rescued += 1
+        self.stats.scavenged += n_rescued
+        return n_rescued
+
+    def _heartbeat(self) -> None:
+        for slot in self.engine.slots:
+            if slot is not None:
+                self.kv.set_ttl(k_lease(slot.request.rid), self.tag,
+                                self.lease_ttl)
+        for req in self.engine.waiting:
+            self.kv.set_ttl(k_lease(req.rid), self.tag, self.lease_ttl)
+
+    def _publish_new(self) -> None:
+        for rid, res in self.engine.results.items():
+            if rid in self._published:
+                continue
+            self.kv.set(k_result(rid), json.dumps(
+                {"rid": rid, "tokens": res.tokens,
+                 "preemptions": res.preemptions, "replica": self.tag}))
+            self.kv.delete(k_lease(rid))
+            self._published.add(rid)
+            self.stats.completed += 1
+
+
+# -- worker process main -----------------------------------------------------
+
+
+def _build_engine(cfg: dict):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_sandbox.models.transformer import (TransformerConfig,
+                                                TransformerLM)
+    from tpu_sandbox.serve.cache import CacheConfig
+    from tpu_sandbox.serve.engine import ContinuousEngine, ServeConfig
+
+    mcfg = TransformerConfig(**{**dict(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=128), **cfg.get("model", {})},
+        dtype=jnp.float32)
+    params = TransformerLM(mcfg).init(
+        jax.random.key(cfg.get("param_seed", 0)),
+        jnp.zeros((1, 8), jnp.int32))["params"]
+    scfg = ServeConfig(
+        model=mcfg,
+        cache=CacheConfig(**cfg.get("cache", {})),
+        max_batch=cfg.get("max_batch", 4),
+        buckets=tuple(cfg.get("buckets", (16, 32))),
+    )
+    return ContinuousEngine(params, scfg)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True,
+                   help="JSON: model/cache/max_batch/buckets/param_seed/"
+                        "lease-ttl overrides")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    cfg = json.loads(args.config)
+
+    port = int(os.environ[ENV_KV_PORT])
+    tag = args.tag or (
+        f"replica-a{os.environ.get('TPU_SANDBOX_AGENT_ID', '?')}"
+        f"-g{os.environ.get('TPU_SANDBOX_GENERATION', '?')}")
+    kv = KVClient(port=port)
+    worker = ReplicaWorker(
+        kv, _build_engine(cfg), tag=tag,
+        lease_ttl=float(cfg.get("lease_ttl", 3.0)))
+
+    def on_term(signum, frame):
+        worker.request_drain()
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        worker.run(timeout=float(cfg.get("timeout", 300.0)))
+    finally:
+        kv.close()
+    if worker._draining:
+        print(f"[{tag}] drained: requeued {worker.stats.requeued} "
+              f"in-flight request(s)", flush=True)
+        return PREEMPTED_EXIT_CODE
+    print(f"[{tag}] done: {worker.stats.__dict__}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
