@@ -58,3 +58,12 @@ class BadAgent:
     def _resolve(self):  # GL-R304: blocking read in a leader section
         verdict = self.kv.get("gen/teardown")
         return verdict
+
+
+class BadFrontend:
+    def __init__(self):
+        self.waiting = []
+
+    def submit(self, request):  # GL-R306: no capacity check, no shed path
+        self.waiting.append(request)
+        return True

@@ -90,3 +90,36 @@ class GoodAgent:
         # blocking get() is FINE outside leader-reachable methods —
         # followers have no lease to lose
         return self.kv.get("gen/launch")
+
+
+class BoundedFrontend:
+    def __init__(self, limit):
+        self.limit = limit
+        self.waiting = []
+        self.shed_log = []
+
+    def submit(self, request):
+        # bounded admission: capacity comparison + an explicit shed path,
+        # so overload produces verdicts instead of memory growth
+        if len(self.waiting) >= self.limit:
+            self._record_shed(request)
+            return False
+        self.waiting.append(request)
+        return True
+
+    def submit_dropping_oldest(self, request):
+        # the other clean spelling: no len() compare in this function,
+        # but the drop call marks it as overload-aware
+        self.drop_expired()
+        self.waiting.append(request)
+
+    def drop_expired(self):
+        del self.waiting[: max(0, len(self.waiting) - self.limit)]
+
+    def _record_shed(self, request):
+        self.shed_log.append(request)
+
+    def requeue(self, request):
+        # appendleft is exempt: requeueing already-admitted work adds
+        # nothing the bounded queue has not already accepted
+        self.waiting.appendleft(request)

@@ -89,9 +89,11 @@ def test_bad_control_fixture_fires_every_rule():
     for f in findings:
         by_rule.setdefault(f.rule, []).append(f)
     assert set(by_rule) == {"GL-R301", "GL-R302", "GL-R303", "GL-R304",
-                            "GL-R305"}
+                            "GL-R305", "GL-R306"}
     # both claim spellings: constant key AND unscoped key helper
     assert len(by_rule["GL-R301"]) == 2
+    # the unbounded queue anchors on the append site
+    assert "waiting" in by_rule["GL-R306"][0].message
     # leader-reachability: the blocking get() is inside _resolve, reached
     # from _leader_tick
     assert "_resolve" in by_rule["GL-R304"][0].message
@@ -270,7 +272,7 @@ def test_graftlint_cli_traces_all_steps():
     """Tier-1 half of the CLI gate: all three passes, jaxpr-tracing the
     real DP/ZeRO/pjit/pipeline steps — plus the engine-flag variants
     (int8 grad compress, bucketed overlap), SeqParallel, and the serve
-    decode step — on CPU. The AOT compiles are skipped here (`--no-aot`)
+    decode + bucketed-prefill steps — on CPU. The AOT compiles are skipped here (`--no-aot`)
     to keep tier-1 inside its time budget — the full chipless AOT receipt
     runs in the slow twin below."""
     report = _run_graftlint("--no-aot")
@@ -278,7 +280,7 @@ def test_graftlint_cli_traces_all_steps():
     assert report["unused_suppressions"] == 0
     hlo = report["hlo"]
     for step in ("dp", "zero", "pjit", "pipeline", "dp-int8",
-                 "dp-overlap", "sp", "decode"):
+                 "dp-overlap", "sp", "decode", "prefill", "prefill-b16"):
         assert hlo[step]["status"] == "traced", hlo
 
 

@@ -131,7 +131,8 @@ def test_submit_list_cancel_roundtrip():
         jobs = list_jobs(kv)
         assert [j["job_id"] for j in jobs] == ["a", "b"]
         assert jobs[0] == {"job_id": "a", "state": "queued", "seq": s1,
-                           "priority": 2, "hosts": 1, "world_size": 1}
+                           "priority": 2, "hosts": 1, "world_size": 1,
+                           "tenant": "", "share": 1.0}
         with pytest.raises(ValueError, match="already exists"):
             submit_job(kv, JobSpec(job_id="a", hosts=1, world_size=1,
                                    agent_argv=["true"]))
@@ -159,9 +160,11 @@ KVClient, for_job = _kv.KVClient, _kv.for_job
 aid = int(sys.argv[1]); port = int(sys.argv[2]); job = sys.argv[3]
 mode = sys.argv[4]; arg = float(sys.argv[5]) if len(sys.argv) > 5 else 0.0
 kv = for_job(KVClient(port=port), job)
-kv.set(f"test/ran/{{aid}}", str(os.getpid()))
 stop = []
 signal.signal(signal.SIGTERM, lambda s, f: stop.append(1))
+# published only after the handler is in place: tests that wait on this
+# key may then SIGTERM us without racing the default (kill) disposition
+kv.set(f"test/ran/{{aid}}", str(os.getpid()))
 
 def beat():
     kv.set_ttl(f"agent_hb/{{aid}}", repr(time.time()), 5.0)
@@ -182,7 +185,7 @@ if mode == "work":        # heartbeat for `arg` seconds, then succeed
         done(False, preempted=True); sys.exit(75)
     done(True); time.sleep(0.1); sys.exit(0)
 elif mode == "mortal":      # first life runs long; respawned lives crash
-    lives = kv.add("test/lives", 1)
+    lives = kv.add(f"test/lives/{{aid}}", 1)
     if lives >= 2:
         sys.exit(9)
     t0 = time.monotonic()
@@ -191,8 +194,9 @@ elif mode == "mortal":      # first life runs long; respawned lives crash
     sys.exit(75 if stop else 0)
 elif mode == "preemptible":
     # first life: run until SIGTERM, checkpoint-through-preemption;
-    # second life: note the resume and finish clean, uncharged
-    lives = kv.add("test/lives", 1)
+    # second life: note the resume and finish clean, uncharged.
+    # lives are PER AGENT: a gang's ranks must not count each other
+    lives = kv.add(f"test/lives/{{aid}}", 1)
     if lives >= 2:
         kv.set("test/resumed", b"1")
         done(True); time.sleep(0.1); sys.exit(0)
@@ -439,6 +443,138 @@ def test_unsatisfiable_job_times_out_with_clean_namespace(agent_script):
         # THE namespace-sweep assertion: no leaked claims anywhere
         assert sched.kv.keys(job_namespace("huge")) == []
         assert "timeout" in job_events(sched.kv, "huge")
+
+
+# -- weighted fair share ---------------------------------------------------
+
+
+def test_weighted_fair_share_converges_to_tenant_weights(agent_script):
+    """Two equal-priority tenants on a pool of 1, shares 2:1.  Jobs are
+    submitted interleaved (so raw seq order favours neither) and all have
+    the same duration; the admission order must track virtual time, i.e.
+    at every decision point the normalised service |served_a/2 - served_b|
+    stays within one job of balanced.  Plain FIFO would drift to 1.5."""
+    alpha = [f"a{i}" for i in range(6)]
+    beta = [f"b{i}" for i in range(3)]
+    with ClusterScheduler(1, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        # interleave submissions: a0 b0 a1 b1 a2 b2 a3 a4 a5
+        order = [j for pair in zip(alpha, beta) for j in pair] + alpha[3:]
+        for jid in order:
+            tenant = "alpha" if jid.startswith("a") else "beta"
+            share = 2.0 if tenant == "alpha" else 1.0
+            sched.submit(JobSpec(
+                job_id=jid, hosts=1, world_size=1, tenant=tenant,
+                share=share,
+                agent_argv=_agent_argv(agent_script, "work", 0.5)))
+        states = sched.serve(timeout=120)
+        assert all(s == "done" for s in states.values()), states
+        admitted = sorted(
+            alpha + beta, key=lambda j: job_events(sched.kv, j)["admitted"])
+        na = nb = 0
+        for jid in admitted:
+            if jid.startswith("a"):
+                na += 1
+            else:
+                nb += 1
+            assert abs(na / 2.0 - nb / 1.0) <= 1.0, \
+                f"service drifted from 2:1 weights at {admitted}"
+        # both tenants were charged virtual time, normalised by share:
+        # 6 jobs at share 2 and 3 jobs at share 1 accrue about equally.
+        va, vb = sched.tenant_vtime("alpha"), sched.tenant_vtime("beta")
+        assert va > 0 and vb > 0
+        assert 0.4 < va / vb < 2.5, (va, vb)
+
+
+# -- serve/train colocation (autoscaler drives the scheduler) --------------
+
+
+def test_autoscaler_preempts_training_and_returns_slots(agent_script):
+    """End-to-end colocation story against a live scheduler: a queue-depth
+    spike makes the autoscaler grow the serve gang at high priority, which
+    preempts the low-priority 2-host training gang (checkpoint-out via
+    SIGTERM, uncharged requeue); once load subsides the gang shrinks
+    newest-first and training resumes on the returned slots and finishes
+    clean.  The whole episode must be reconstructable from job_events +
+    autoscale_events alone.  (Bitwise resume parity is proven by
+    test_priority_preemption_checkpoints_and_resumes and the checkpoint
+    suite; replica drain zero-loss by the serve SLO/chaos tests — here the
+    stub agents prove the slot choreography.)"""
+    from tpu_sandbox.serve.autoscale import (AutoscaleConfig,
+                                             ReplicaAutoscaler,
+                                             autoscale_events)
+    from tpu_sandbox.serve.replica import k_load
+
+    with ClusterScheduler(2, poll=0.02, extra_env=ENV,
+                          verbose=False) as sched:
+        sched.submit(JobSpec(
+            job_id="train", hosts=2, world_size=2, priority=0,
+            tenant="train",
+            agent_argv=_agent_argv(agent_script, "preemptible")))
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state("train")) == b"running"
+            and sched.kv.keys("job/train/test/ran/")))
+        asc = ReplicaAutoscaler(
+            sched.kv, _agent_argv(agent_script, "work", 60.0),
+            cfg=AutoscaleConfig(min_replicas=0, max_replicas=2,
+                                scale_up_depth=4.0, scale_down_depth=0.5,
+                                hysteresis_ticks=1, cooldown_s=0.0,
+                                priority=10))
+
+        def report(depth):
+            sched.kv.set_ttl(k_load("stub"),
+                             json.dumps({"queue_depth": depth}), 60.0)
+
+        # overload: the replica engines report deep queues
+        report(9.0)
+        up1 = asc.tick()
+        assert up1 and up1["action"] == "scale_up" \
+            and up1["reason"] == "queue_depth"
+        rep1, rep2 = up1["job_id"], None
+        # the 1-host serve job outranks the 2-host training gang: training
+        # is SIGTERMed, checkpoints out, and requeues at its original seq
+        assert _tick_until(sched, lambda: (
+            sched.kv.keys(f"job/{rep1}/test/ran/")
+            and sched.kv.try_get(k_state("train")) == b"queued"))
+        up2 = asc.tick()
+        assert up2 and up2["action"] == "scale_up" and up2["n_after"] == 2
+        rep2 = up2["job_id"]
+        # wait for the replica agents themselves (not just the admission
+        # record) so the scale-down SIGTERM can't race their startup
+        assert _tick_until(sched, lambda: (
+            sched.kv.keys(f"job/{rep2}/test/ran/")))
+        # training needs 2 hosts and 0 are free: it must stay queued, NOT
+        # half-launch (gang admission is all-or-nothing)
+        assert sched.kv.try_get(k_state("train")) == b"queued"
+
+        # load subsides: shrink newest-first, handing slots back
+        report(0.0)
+        down1 = asc.tick()
+        assert down1 and down1["action"] == "scale_down" \
+            and down1["job_id"] == rep2
+        assert _tick_until(sched, lambda: (
+            sched.kv.try_get(k_state(rep2)) == b"cancelled"))
+        # 1 free host is still not enough for the 2-host training gang
+        assert sched.kv.try_get(k_state("train")) == b"queued"
+        down2 = asc.tick()
+        assert down2 and down2["action"] == "scale_down" \
+            and down2["job_id"] == rep1
+
+        states = sched.serve(timeout=120)
+        assert states["train"] == "done", states
+        assert states[rep1] == "cancelled" and states[rep2] == "cancelled"
+        # the resumed verdict is the second stub life's, uncharged
+        verdict = json.loads(sched.kv.get(k_verdict("train")))
+        assert verdict["ok"] and verdict["restarts"] == 0
+        # the timeline: preempted before the re-admission that finished it
+        ev = job_events(sched.kv, "train")
+        assert ev["admitted"] <= ev["preempt_sent"] <= ev["preempted"] \
+            <= ev["readmitted"]
+        # and the autoscaler's own event log tells the same story
+        actions = [(e["action"], e["job_id"])
+                   for e in autoscale_events(sched.kv)]
+        assert actions == [("scale_up", rep1), ("scale_up", rep2),
+                           ("scale_down", rep2), ("scale_down", rep1)]
 
 
 # -- scheduler death / adoption (satellite: random kill orders) ------------

@@ -383,6 +383,62 @@ def test_replica_scavenge_rescues_orphaned_claims(params, step, greedy):
         server.stop()
 
 
+def test_sampled_decode_interrupted_mid_decode_replays_bitwise(params, step):
+    """Replay-exact sampling through a kill: a temperature/top-k request is
+    claimed, decoded partway, then its worker drains (the SIGTERM path) and
+    a peer re-executes it from scratch — the final tokens are bitwise
+    identical to an uninterrupted run, because each sampled step draws from
+    ``fold_in(key(seed), step_index)``, not from mutable sampler state."""
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+    from tpu_sandbox.serve import replica as R
+
+    rng = np.random.default_rng(6)
+    prompt = [int(t) for t in rng.integers(1, 64, size=9)]
+    kwargs = dict(max_new_tokens=12, temperature=3.0, top_k=8, seed=7)
+
+    # the uninterrupted reference run, and proof the sampler is live
+    ref = ContinuousEngine(params, _scfg(), step=step)
+    ref.submit(Request(rid="ref", prompt=list(prompt), **kwargs))
+    ref.run_until_idle()
+    want = ref.results["ref"].tokens
+    greedy_eng = ContinuousEngine(params, _scfg(), step=step)
+    greedy_eng.submit(Request(rid="g", prompt=list(prompt),
+                              max_new_tokens=12))
+    greedy_eng.run_until_idle()
+    assert want != greedy_eng.results["g"].tokens, \
+        "temperature-3.0 sampling reproduced greedy — sampler not engaged"
+
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    try:
+        R.submit_request(kv, "s", prompt, 12, temperature=3.0, top_k=8,
+                         seed=7)
+        R.announce_total(kv, 1)
+        w1 = R.ReplicaWorker(kv, ContinuousEngine(params, _scfg(),
+                                                  step=step),
+                             tag="w1", lease_ttl=0.5)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            w1.tick()
+            slots = [s for s in w1.engine.slots
+                     if s is not None and s.request.rid == "s"]
+            if slots and len(slots[0].generated) >= 3:
+                break
+        assert slots and 3 <= len(slots[0].generated) < 12, \
+            "no mid-decode window"
+        w1.request_drain()
+        w1.tick()
+        assert w1.stats.requeued == 1
+        w2 = R.ReplicaWorker(kv, ContinuousEngine(params, _scfg(),
+                                                  step=step),
+                             tag="w2", lease_ttl=0.5)
+        w2.run(timeout=60)
+        assert R.read_result(kv, "s", timeout=5)["tokens"] == want
+    finally:
+        kv.close()
+        server.stop()
+
+
 # -- bench smoke ------------------------------------------------------------
 
 

@@ -208,6 +208,119 @@ def test_replica_gang_survives_agent_kill_with_zero_loss(tmp_path):
         server.stop()
 
 
+def test_overload_plus_agent_kill_yields_exactly_one_verdict_each(tmp_path):
+    """Chaos + SLO accounting: an overloaded gang (deadline'd cohorts
+    queued behind cold compiles) loses an agent mid-load, and still every
+    submitted request terminates with EXACTLY one verdict — an ok result
+    or an explicit SHED — with no ok published materially past its
+    deadline and no corruption in anything that did complete.
+
+    Cohorts: A has no deadline (must all complete, bitwise-reference);
+    B's deadline leaves room to finish unless the kill/relaunch eats it
+    (either verdict is legal); C's deadline is tighter than the first
+    cold compile, so C guarantees the shed path runs under chaos."""
+    from tpu_sandbox.runtime.faults import agent_cmd_key
+    from tpu_sandbox.runtime.host_agent import K_JOB_DONE, AgentLauncher
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+    from tpu_sandbox.serve import replica as R
+
+    rng = np.random.default_rng(1)
+    reqs = _requests(rng, 60)
+    cohort = {rid: ("A", "B", "C")[i % 3] for i, (rid, _, _) in
+              enumerate(reqs)}
+
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    cfg_json = json.dumps(SERVE_CFG)
+
+    def agent_cmd(aid, kv_port):
+        return [sys.executable, str(Path(__file__).resolve()),
+                "--serve-agent", "--agent-id", str(aid),
+                "--agents", "2", "--kv-port", str(kv_port),
+                "--config", cfg_json]
+
+    launcher = AgentLauncher(
+        2, agent_cmd, kv_server=server,
+        extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "JAX_THREEFRY_PARTITIONABLE": "1",
+            "PYTHONPATH": str(REPO) + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        })
+    rc = []
+    thread = threading.Thread(target=lambda: rc.append(launcher.run()),
+                              name="chaos-launcher")
+    try:
+        t0 = time.time()
+        deadlines = {}
+        for rid, prompt, max_new in reqs:
+            dl = {"A": None, "B": t0 + 25.0, "C": t0 + 2.5}[cohort[rid]]
+            deadlines[rid] = dl
+            R.submit_request(kv, rid, prompt, max_new, deadline_unix=dl)
+        R.announce_total(kv, len(reqs))
+
+        thread.start()
+
+        # monitor the verdict stream: first-seen wall time per rid, and
+        # the kill once the gang is demonstrably mid-load
+        first_seen = {}
+        killed = False
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            for key in kv.keys("serve/result/"):
+                rid = key[len("serve/result/"):]
+                first_seen.setdefault(rid, time.time())
+            if not killed and len(first_seen) >= 3:
+                kv.set(agent_cmd_key(1),
+                       json.dumps({"action": "kill_agent"}))
+                n_at_kill = len(first_seen)
+                killed = True
+            if len(first_seen) >= len(reqs):
+                break
+            time.sleep(0.05)
+        assert killed and n_at_kill < len(reqs), "no mid-load kill window"
+        thread.join(timeout=120)
+        assert not thread.is_alive(), "launcher never saw a job verdict"
+        assert launcher.respawns >= 1, "agent 1 was never replaced"
+
+        # exactly one terminal verdict per request, nothing extra
+        results = {}
+        for key in kv.keys("serve/result/"):
+            rid = key[len("serve/result/"):]
+            results[rid] = json.loads(kv.get(key))
+        assert set(results) == {rid for rid, _, _ in reqs}
+        ok = {r for r, v in results.items() if v["verdict"] == "ok"}
+        shed = {r for r, v in results.items() if v["verdict"] == "SHED"}
+        assert ok | shed == set(results) and not (ok & shed)
+        # the undeadlined cohort can never legally shed; the
+        # tighter-than-one-compile cohort guarantees sheds happened
+        assert {r for r in shed if cohort[r] == "A"} == set()
+        assert shed, "overload produced no sheds — not an overload"
+        for r in shed:
+            assert results[r]["reason"], results[r]
+        # no ok verdict materially past its deadline (engine-clock
+        # lateness becomes a SHED in _retire; the slack covers publish
+        # tick + monitor poll latency only)
+        for r in ok:
+            if deadlines[r] is not None and r in first_seen:
+                assert first_seen[r] <= deadlines[r] + 2.0, \
+                    (r, first_seen[r] - deadlines[r])
+        # everything that did complete is bitwise-identical to the
+        # unfaulted greedy reference — chaos may shed, never corrupt
+        want = _greedy_reference([q for q in reqs if q[0] in ok])
+        for r in ok:
+            assert results[r]["tokens"] == want[r], r
+        # and the kill really exercised the requeue machinery
+        assert int(kv.get(R.K_TAIL)) > len(reqs)
+    finally:
+        if thread.is_alive():
+            kv.set(K_JOB_DONE, json.dumps(
+                {"ok": False, "reason": "test teardown"}))
+            thread.join(timeout=60)
+        kv.close()
+        server.stop()
+
+
 def test_bench_serve_cli_prints_one_json_line():
     """The `bench.py --metric serve --quick` CLI path end to end in a
     fresh interpreter (the tier-1 smoke calls bench_serve in-process)."""
@@ -224,6 +337,29 @@ def test_bench_serve_cli_prints_one_json_line():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["metric"] == "serve"
     assert out["outputs_match"] is True
+
+
+def test_bench_serve_slo_cli_prints_one_json_line():
+    """`bench.py --metric serve_slo --quick` end to end: the calibrated
+    overload comparison runs and reports its guardrail claims. Quick mode
+    is too small for the claims to be meaningful, so only their presence
+    and the accounting invariant are asserted here; BENCH_r06.json holds
+    a committed full run."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"),
+         "--metric", "serve_slo", "--quick"],
+        capture_output=True, text=True, timeout=300, cwd=root,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_slo"
+    assert out["every_request_verdicted"] is True
+    g = out["guarded_overload"]
+    assert g["completed"] + g["shed"] == out["requests"]
 
 
 if __name__ == "__main__":

@@ -1,7 +1,7 @@
-"""Pass 3: control-plane lint over ``runtime/`` (AST).
+"""Pass 3: control-plane lint over ``runtime/`` and ``serve/`` (AST).
 
-Five rules distilled from this repo's own elastic-runtime incident
-history:
+Six rules distilled from this repo's own elastic-runtime and serving
+incident history:
 
 - **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
   generation/term/round discriminator. An unscoped claim-once key stays
@@ -29,6 +29,15 @@ history:
   job deadlocks (the ROADMAP launch-storm carry-over). Batch the loop
   into the program (``lax.scan``/``fori_loop``) or hoist the dispatch
   out of the loop.
+- **GL-R306** — ``.append()`` onto a queue-ish attribute (``queue``,
+  ``waiting``, ``pending``, ``backlog``, ``inbox``, ``mailbox``) in a
+  function with no capacity comparison on that queue and no shed/drop
+  path. An unbounded producer-facing queue converts overload into
+  unbounded memory growth and unbounded tail latency; the fix is a
+  bounded queue that sheds with an explicit verdict (the
+  ``serve/engine.ContinuousEngine.submit`` idiom). ``appendleft`` is
+  deliberately exempt: requeueing already-admitted work (preemption)
+  adds nothing the queue has not already accepted.
 """
 
 from __future__ import annotations
@@ -41,11 +50,28 @@ from tpu_sandbox.analysis.findings import Finding, make_finding
 #: identifiers that count as a per-round discriminator inside a claim key
 SCOPE_TOKENS = frozenset({
     "gen", "generation", "term", "index", "idx", "step", "epoch",
-    "attempt", "round", "fault", "token", "nonce", "seq",
+    "attempt", "round", "fault", "token", "nonce", "seq", "rid",
 })
 
 #: attribute names that mark a receiver as "the KV client"
 KV_RECEIVERS = frozenset({"kv", "client", "store", "_kv", "_client", "_store"})
+
+#: attribute names that mark an in-memory collection as a request queue
+QUEUE_NAMES = frozenset({
+    "queue", "waiting", "pending", "backlog", "inbox", "mailbox",
+})
+
+#: call-name substrings that mark a function as overload-aware — it has
+#: somewhere to put work it refuses (shed verdicts, drop/evict paths)
+SHED_MARKERS = ("shed", "drop", "reject", "evict")
+
+
+def _is_queueish(name: str | None) -> bool:
+    if name is None:
+        return False
+    low = name.lstrip("_").lower()
+    return low in QUEUE_NAMES or any(
+        low.endswith("_" + q) for q in QUEUE_NAMES)
 
 
 def _final_attr(node: ast.AST) -> str | None:
@@ -321,6 +347,52 @@ class _FnLinter:
                     return name
         return None
 
+    # -- GL-R306 -------------------------------------------------------------
+
+    def _check_unbounded_queues(self, fn: ast.AST) -> None:
+        """``.append()`` onto a queue-ish attribute in a function with no
+        capacity comparison on that queue and no shed/drop call.
+
+        ``appendleft`` (requeue of already-admitted work) is exempt, and
+        a ``len(<queue>)`` that appears inside any comparison counts as
+        the capacity check even when it guards a different branch — this
+        is a lint heuristic, not a proof."""
+        appends: list[tuple[ast.Call, str]] = []
+        len_compared: set[str] = set()
+        sheds = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    for sub in ast.walk(side):
+                        if isinstance(sub, ast.Call) \
+                                and _final_attr(sub.func) == "len" \
+                                and sub.args:
+                            qn = _final_attr(sub.args[0])
+                            if qn is not None:
+                                len_compared.add(qn)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = _final_attr(node.func)
+            if name is None:
+                continue
+            if name == "append" and isinstance(node.func, ast.Attribute):
+                qname = _final_attr(node.func.value)
+                if _is_queueish(qname):
+                    appends.append((node, qname))
+            elif any(m in name.lower() for m in SHED_MARKERS):
+                sheds = True
+        if sheds:
+            return
+        for node, qname in appends:
+            if qname in len_compared:
+                continue
+            self._emit(
+                "GL-R306", node,
+                f"append to '{qname}' with no capacity check and no shed "
+                f"path — overload grows this queue without bound",
+            )
+
     # -- GL-R304 (per-class, run separately) ---------------------------------
 
     def run_common(self, fn: ast.AST) -> None:
@@ -332,6 +404,7 @@ class _FnLinter:
                 self._check_claim(node)
         self._check_stamp_math(fn)
         self._check_threads(fn)
+        self._check_unbounded_queues(fn)
 
 
 def _leader_reachable(cls: ast.ClassDef) -> set[str]:
@@ -549,14 +622,16 @@ def lint_source(source: str, path: str) -> list[Finding]:
 def run_control_pass(
     root: str, *, paths: list[str] | None = None,
 ) -> list[Finding]:
-    """Lint ``runtime/`` (or explicit ``paths``); labels are root-relative."""
+    """Lint ``runtime/`` + ``serve/`` (or explicit ``paths``); labels are
+    root-relative."""
     if paths is None:
-        runtime = os.path.join(root, "tpu_sandbox", "runtime")
         paths = []
-        if os.path.isdir(runtime):
-            for fn in sorted(os.listdir(runtime)):
-                if fn.endswith(".py"):
-                    paths.append(os.path.join(runtime, fn))
+        for pkg in ("runtime", "serve"):
+            pkg_dir = os.path.join(root, "tpu_sandbox", pkg)
+            if os.path.isdir(pkg_dir):
+                for fn in sorted(os.listdir(pkg_dir)):
+                    if fn.endswith(".py"):
+                        paths.append(os.path.join(pkg_dir, fn))
     findings: list[Finding] = []
     for p in paths:
         rel = os.path.relpath(p, root)

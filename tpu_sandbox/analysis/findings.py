@@ -6,7 +6,7 @@ baseline suppressor all speak one format. Rule ids are grouped by pass:
 
 - ``GL-C1xx``  Pass 1: collective consistency (AST, SPMD-divergence class)
 - ``GL-H2xx``  Pass 2: jaxpr / chipless AOT HLO step lint
-- ``GL-R3xx``  Pass 3: control-plane lint (AST over runtime/)
+- ``GL-R3xx``  Pass 3: control-plane lint (AST over runtime/ + serve/)
 """
 
 from __future__ import annotations
@@ -112,6 +112,13 @@ RULES: dict[str, tuple[str, str]] = {
         "rendezvous; a Python-speed storm of them interleaves across "
         "ranks and deadlocks XLA:CPU gangs — batch the loop into the "
         "program (lax.scan / fori_loop) or hoist the dispatch out",
+    ),
+    "GL-R306": (
+        "unbounded in-memory request queue",
+        "a producer-facing queue appended to with no capacity comparison "
+        "and no shed path turns overload into unbounded memory growth and "
+        "unbounded tail latency; bound the queue and shed with an explicit "
+        "verdict (see serve/engine.ContinuousEngine.submit)",
     ),
 }
 

@@ -10,7 +10,7 @@ Two layers, split so the cheap one is always available:
   and feeds them through: it traces ``DataParallel`` (plain, ZeRO, and
   the int8-grad-compress / bucketed-overlap flag variants),
   ``PjitEngine``, ``PipelineParallel``, ``SeqParallel``, and the serve
-  decode step to jaxprs on CPU
+  decode + bucketed-prefill steps to jaxprs on CPU
   devices, then AOT-compiles the DP/ZeRO steps against a multi-chip v5e
   topology (``tools/aot_v5e.make_topology``) to verify input donation
   from XLA's own ``memory_analysis`` and to check the overlapped
@@ -335,6 +335,30 @@ def _trace_targets(steps) -> tuple[list[Finding], dict]:
               jax.ShapeDtypeStruct((2, 1), jnp.int32),
               jax.ShapeDtypeStruct((2,), jnp.int32),
               jax.ShapeDtypeStruct((2, ccfg.max_blocks_per_seq), jnp.int32))
+    if "prefill" in steps:
+        from tpu_sandbox.models.transformer import TransformerConfig
+        from tpu_sandbox.models.transformer import TransformerLM
+        from tpu_sandbox.serve.cache import CacheConfig
+        from tpu_sandbox.serve.decode import make_prefill_fn, page_shapes
+
+        cfg_p = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                  n_layers=2, d_ff=64, max_len=64)
+        pcfg = CacheConfig(num_blocks=16, block_size=8,
+                           max_blocks_per_seq=4)
+        pparams = jax.eval_shape(
+            lambda: TransformerLM(cfg_p).init(
+                jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+        kp, vp = page_shapes(cfg_p, pcfg, jnp.float32)
+        # one trace per bucket length: each bucket is its own static-shape
+        # program in the serve AOT set, and padding scatters through the
+        # null block have their own upcast/host-transfer surface
+        for bucket in (8, 16):
+            trace("prefill" if bucket == 8 else f"prefill-b{bucket}",
+                  make_prefill_fn(cfg_p, pcfg),
+                  pparams, kp, vp,
+                  jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                  jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                  jax.ShapeDtypeStruct((), jnp.int32))
     return findings, report
 
 
@@ -415,7 +439,7 @@ def _aot_targets(steps, *, topology: str, chips, overlap_check: bool,
 def run_hlo_pass(
     *,
     steps=("dp", "zero", "pjit", "pipeline", "dp-int8", "dp-overlap",
-           "sp", "decode"),
+           "sp", "decode", "prefill"),
     aot: bool = True,
     topology: str = "v5e:2x2x1",
     chips=(2, 2, 1),

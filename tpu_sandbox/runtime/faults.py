@@ -49,6 +49,17 @@ Actions:
                     seconds while its ranks keep running — the network
                     partition only agent-level heartbeat monitoring can see.
                     Routed through the same agent mailbox.
+``shed_storm``      post a shed order on a serve replica's fault mailbox
+                    (``serve/cmd/<target>``): the replica sheds its whole
+                    local waiting queue with explicit SHED verdicts — the
+                    burst-overload case the SLO layer must answer without
+                    hanging any client.
+``stall_replica``   freeze the replica named by ``target`` for longer than
+                    its lease TTL (``serve/cmd/<tag>:<seconds>`` target
+                    syntax). Its leases lapse, peers scavenge its claims,
+                    and the client-side hedging/retry path gets a live
+                    straggler to race. Same mailbox, consumed by
+                    ``ReplicaWorker._poll_faults``.
 """
 
 from __future__ import annotations
@@ -64,7 +75,7 @@ ENV_PLAN = "TPU_SANDBOX_FAULT_PLAN"
 
 ACTIONS = ("kill", "sigterm", "hang_heartbeat", "corrupt_ckpt",
            "corrupt_shard", "kill_during_commit", "kill_agent",
-           "partition_host")
+           "partition_host", "shed_storm", "stall_replica")
 
 #: Actions that fire inside the checkpoint commit window (via
 #: ``maybe_fire_commit``) rather than at an optimizer-step boundary.
@@ -76,6 +87,12 @@ COMMIT_ACTIONS = ("kill_during_commit",)
 #: TPU_SANDBOX_AGENT_ID in the rank's env).
 AGENT_ACTIONS = ("kill_agent", "partition_host")
 
+#: Actions executed by a serve REPLICA: posted to its fault mailbox
+#: (``serve/cmd/<tag>``), consumed once per fault by the replica's poll
+#: loop. ``target`` names the replica tag; ``stall_replica`` may append
+#: ``:<seconds>`` for the stall duration.
+SERVE_ACTIONS = ("shed_storm", "stall_replica")
+
 ENV_AGENT_ID = "TPU_SANDBOX_AGENT_ID"
 
 
@@ -83,6 +100,12 @@ def agent_cmd_key(agent_id: int | str) -> str:
     """The agent's fault-command mailbox (single-slot: agents consume it
     with delete-after-read)."""
     return f"agent/cmd/{agent_id}"
+
+
+def serve_cmd_key(tag: str) -> str:
+    """A serve replica's fault mailbox (single-slot, delete-after-read —
+    mirrors the agent mailbox; key layout owned by serve/replica.py)."""
+    return f"serve/cmd/{tag}"
 
 
 def agent_id_from_env(environ: Mapping[str, str] | None = None) -> int | None:
@@ -105,6 +128,11 @@ class Fault:
         if self.action in ("corrupt_ckpt", "corrupt_shard") and not self.target:
             raise ValueError(
                 f"{self.action} needs target=<checkpoint dir>"
+            )
+        if self.action in SERVE_ACTIONS and not self.target:
+            raise ValueError(
+                f"{self.action} needs target=<replica tag>"
+                + (":<seconds>" if self.action == "stall_replica" else "")
             )
         if self.action == "partition_host" and self.target is not None:
             try:
@@ -259,6 +287,14 @@ class FaultInjector:
                 agent_cmd_key(self.agent_id),
                 json.dumps({"action": f.action, "arg": f.target}),
             )
+        elif f.action in SERVE_ACTIONS:
+            if self.kv is None:
+                raise RuntimeError(f"{f.action} needs a KV store")
+            tag, _, dur = f.target.partition(":")
+            body = {"action": f.action}
+            if dur:
+                body["duration"] = float(dur)
+            self.kv.set(serve_cmd_key(tag), json.dumps(body))
 
 
 # -- checkpoint corruption (also used directly by tests) -------------------

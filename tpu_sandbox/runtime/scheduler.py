@@ -129,6 +129,12 @@ class JobSpec:
     priority: int = 0
     admission_timeout: float = 120.0
     env: dict[str, str] = field(default_factory=dict)
+    # weighted fair share: jobs naming a tenant are admitted (within a
+    # priority band) in order of the tenant's accumulated normalized
+    # service — host-seconds / share — so one tenant cannot starve the
+    # pool. Untenanted jobs keep plain FIFO-by-seq semantics.
+    tenant: str = ""
+    share: float = 1.0
 
     def __post_init__(self):
         if not job_namespace(self.job_id):
@@ -138,6 +144,8 @@ class JobSpec:
             )
         if self.hosts < 1:
             raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        if self.share <= 0:
+            raise ValueError(f"share must be > 0, got {self.share}")
         assign_ranks(self.world_size, self.hosts)  # validates the gang shape
         self.format_argv(agent_id=0, kv_port=0)  # fail bad templates early
 
@@ -184,7 +192,7 @@ def submit_job(kv: KVClient, spec: JobSpec) -> int:
 
 def list_jobs(kv: KVClient) -> list[dict]:
     """Every job the store knows, queued order first. Each entry:
-    ``{job_id, state, seq, priority, hosts, world_size}``."""
+    ``{job_id, state, seq, priority, hosts, world_size, tenant, share}``."""
     out = []
     for key in kv.keys(JOBS_PREFIX):
         if not key.endswith("/spec"):
@@ -202,6 +210,8 @@ def list_jobs(kv: KVClient) -> list[dict]:
             "priority": spec.priority,
             "hosts": spec.hosts,
             "world_size": spec.world_size,
+            "tenant": spec.tenant,
+            "share": spec.share,
         })
     return sorted(out, key=lambda j: j["seq"])
 
@@ -322,6 +332,12 @@ class ClusterScheduler:
         self._server: KVServer | None = None
         self._running: dict[str, _RunningJob] = {}
         self._queue_deadline: dict[str, float] = {}
+        # tenant -> accumulated normalized service (host-seconds / share);
+        # scheduler-lifetime state, deliberately not durable: fair share is
+        # a steady-state property, a successor restarting from zero only
+        # forgets old debts
+        self._tenant_vtime: dict[str, float] = {}
+        self._last_charge = time.monotonic()
         self._stop = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -422,6 +438,7 @@ class ClusterScheduler:
         """One scheduling pass; returns the currently queued entries."""
         self._poll_cancellations()
         self._poll_running()
+        self._charge_tenants()
         queued = [j for j in list_jobs(self.kv) if j["state"] == QUEUED]
         self._admit_or_preempt(queued)
         return [j for j in list_jobs(self.kv) if j["state"] == QUEUED]
@@ -594,10 +611,31 @@ class ClusterScheduler:
         used = sum(j.spec.hosts for j in self._running.values())
         return self.pool_size - used
 
+    def _charge_tenants(self) -> None:
+        """Accrue each running tenant's normalized service. Charged per
+        tick so fair share reflects time actually held, not job count."""
+        now = time.monotonic()
+        dt, self._last_charge = now - self._last_charge, now
+        for job in self._running.values():
+            tenant = job.spec.tenant
+            if tenant:
+                self._tenant_vtime[tenant] = (
+                    self._tenant_vtime.get(tenant, 0.0)
+                    + job.spec.hosts * dt / job.spec.share)
+
+    def tenant_vtime(self, tenant: str) -> float:
+        return self._tenant_vtime.get(tenant, 0.0)
+
     def _admit_or_preempt(self, queued: list[dict]) -> None:
         if not queued:
             return
-        order = sorted(queued, key=lambda j: (-j["priority"], j["seq"]))
+        # priority first; within a band, tenants with the least normalized
+        # service go first (untenanted jobs charge nothing and stay pure
+        # FIFO among themselves); seq breaks the remaining ties
+        order = sorted(queued, key=lambda j: (
+            -j["priority"],
+            self._tenant_vtime.get(j["tenant"], 0.0) if j["tenant"] else 0.0,
+            j["seq"]))
         # expire everyone's admission deadline, not just the head's — a
         # low-priority job stuck behind a high-priority head must still
         # time out on schedule

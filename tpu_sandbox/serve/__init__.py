@@ -6,14 +6,21 @@ Layers (bottom up):
   sequence block tables, free-list reuse, refcounted prefix sharing.
 - ``decode``  — AOT-compiled static-shape prefill (bucketed lengths) and
   single-token decode step for ``models/transformer.py``, both donating the
-  device page buffers.
+  device page buffers; replay-exact seeded sampling (``sample_token``).
 - ``engine``  — continuous-batching engine: admits/evicts sequences at
   decode-step granularity, preempts-to-requeue under block pressure, plus a
-  static-batch baseline for the bench comparison.
+  static-batch baseline for the bench comparison. SLO guardrails live here:
+  per-request deadlines, a bounded admission queue with shed-on-overload,
+  and the load-report backpressure signals.
 - ``replica`` — replica processes behind the KV-backed request queue:
-  claim-once queue entries, TTL leases, idempotent results, SIGTERM drain
-  back to the queue, orphan scavenging. Replicas run as ranks of a
+  claim-once queue entries, TTL leases, idempotent results, claim-once
+  terminal verdicts (result or SHED), SIGTERM drain back to the queue,
+  orphan scavenging, TTL'd load reports. Replicas run as ranks of a
   HostAgent gang so the elastic runtime relaunches them.
+- ``client``  — producer-side SLO machinery: deadline submit, retry-on-shed
+  with jittered backoff, straggler hedging over the idempotent verdicts.
+- ``autoscale`` — leader-elected control loop sizing the replica gang from
+  the load reports through the cluster scheduler (serve/train colocation).
 """
 
 from tpu_sandbox.serve.cache import CacheConfig, PagedKVCache
@@ -22,6 +29,7 @@ from tpu_sandbox.serve.engine import (
     Request,
     RequestResult,
     ServeConfig,
+    ShedRecord,
     StaticEngine,
     live_engines,
 )
@@ -33,6 +41,7 @@ __all__ = [
     "Request",
     "RequestResult",
     "ServeConfig",
+    "ShedRecord",
     "StaticEngine",
     "live_engines",
 ]

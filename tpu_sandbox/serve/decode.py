@@ -39,9 +39,34 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
 from tpu_sandbox.serve.cache import CacheConfig
+
+
+def sample_token(logits_row: np.ndarray, *, seed: int, step_index: int,
+                 temperature: float, top_k: int = 0) -> int:
+    """Replay-exact temperature/top-k sampling over one row of fp32 logits.
+
+    The draw is keyed by ``fold_in(key(seed), step_index)``, where
+    ``step_index`` is the request's decode-step index (number of tokens
+    generated so far). A request that is preempt-requeued or replayed after
+    replica death re-runs from its original prompt, recomputes bitwise
+    identical logits (see module docstring), folds the same indices into
+    the same key, and therefore re-draws the same tokens — sampling keeps
+    the same zero-loss guarantee as greedy decode.
+
+    Gumbel-max over host fp32: ``argmax(logits/T + g)`` with Gumbel noise
+    from ``jax.random`` — deterministic given the key, no CDF rounding.
+    """
+    logits = np.asarray(logits_row, np.float32) / np.float32(temperature)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = np.sort(logits)[-top_k]
+        logits = np.where(logits >= kth, logits, -np.inf)
+    key = jax.random.fold_in(jax.random.key(seed), step_index)
+    g = np.asarray(jax.random.gumbel(key, logits.shape, jnp.float32))
+    return int((logits + g).argmax())
 
 
 @dataclass(frozen=True)

@@ -17,9 +17,25 @@ paged allocator, and advances the whole replica one decode step at a time:
 it finishes, then admit the next batch. Same compiled steps, same
 allocator — the bench compares scheduling policy only.
 
-Decoding is greedy argmax over fp32 logits — deterministic, which is what
-makes requeue/replay and the replica zero-loss story exact rather than
-probabilistic.
+Decoding is greedy argmax over fp32 logits by default — deterministic,
+which is what makes requeue/replay and the replica zero-loss story exact
+rather than probabilistic. Sampled decode (``temperature``/``top_k`` on the
+request) keeps the same guarantee: the sampler key is derived from the
+request seed folded with the decode-step index, so a replayed request
+re-draws identical tokens (see ``serve/decode.py:sample_token``).
+
+SLO guardrails live here too:
+
+- requests may carry an absolute **deadline** (engine clock); waiting or
+  active requests past their deadline are **shed** — removed from the
+  system with an explicit :class:`ShedRecord` instead of silently rotting
+  in the queue;
+- ``ServeConfig.max_waiting`` bounds the admission queue — on overload
+  ``submit`` first sheds oldest-past-deadline waiters, then sheds the
+  incoming request if the queue is still full (the caller learns from the
+  ``False`` return and the shed record);
+- ``load_report()`` exposes the backpressure signals (queue depth,
+  block-pool pressure, decode-step lag) replicas publish to the KV store.
 """
 
 from __future__ import annotations
@@ -35,7 +51,8 @@ import numpy as np
 
 from tpu_sandbox.models.transformer import TransformerConfig
 from tpu_sandbox.serve.cache import CacheConfig, PagedKVCache, SeqAlloc
-from tpu_sandbox.serve.decode import DecodeStep, build_decode_step, init_pages
+from tpu_sandbox.serve.decode import (DecodeStep, build_decode_step,
+                                      init_pages, sample_token)
 
 # engines with a live decode loop / replica thread, for the conftest leak
 # fixture (mirrors kvstore.live_servers())
@@ -54,6 +71,7 @@ class ServeConfig:
     buckets: tuple[int, ...] = (16, 32, 64)
     cache_dtype: Any = jnp.float32
     eos_token: int | None = None  # None -> run to max_new_tokens
+    max_waiting: int = 0          # admission-queue bound; 0 = unbounded
 
 
 @dataclass
@@ -63,6 +81,10 @@ class Request:
     max_new_tokens: int
     arrival: float = 0.0  # engine clock time the request became visible
     preemptions: int = 0  # times evicted-to-requeue so far
+    deadline: float | None = None  # engine clock; past it -> shed, not served
+    temperature: float = 0.0       # 0 -> greedy argmax
+    top_k: int = 0                 # 0 -> full vocab
+    seed: int = 0                  # sampler key; folded with the step index
 
 
 @dataclass
@@ -72,6 +94,16 @@ class RequestResult:
     ttft: float                   # first-token latency (s, engine clock)
     itl: list[float]              # inter-token latencies (s)
     finished_at: float = 0.0
+    preemptions: int = 0
+
+
+@dataclass
+class ShedRecord:
+    """Terminal verdict for a request the engine refused or gave up on.
+    A shed request never also produces a RequestResult."""
+    rid: str
+    reason: str       # "queue_full" | "deadline" | explicit shed reason
+    shed_at: float
     preemptions: int = 0
 
 
@@ -103,7 +135,9 @@ class _EngineBase:
         self.waiting: deque[Request] = deque()
         self.slots: list[_Slot | None] = [None] * config.max_batch
         self.results: dict[str, RequestResult] = {}
+        self.shed: dict[str, ShedRecord] = {}
         self.steps = 0
+        self.last_step_at: float | None = None
         _LIVE_ENGINES.add(self)
 
     # -- public --------------------------------------------------------------
@@ -112,11 +146,23 @@ class _EngineBase:
     def active_requests(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request) -> bool:
+        """Admit ``request`` to the waiting queue. Returns False when the
+        request was shed instead (bounded queue full even after expired
+        waiters were swept) — a ShedRecord is written either way, so every
+        submitted request reaches exactly one terminal verdict."""
         if self.cache.blocks_needed(request.prompt, request.max_new_tokens) \
                 > self.config.cache.max_blocks_per_seq:
             raise ValueError(f"request {request.rid} exceeds max context")
+        limit = self.config.max_waiting
+        if limit and len(self.waiting) >= limit:
+            # shed-on-overload: oldest-past-deadline first, then the arrival
+            self.shed_expired()
+            if len(self.waiting) >= limit:
+                self._record_shed(request, "queue_full")
+                return False
         self.waiting.append(request)
+        return True
 
     @property
     def idle(self) -> bool:
@@ -142,6 +188,66 @@ class _EngineBase:
         out.extend(self.waiting)
         self.waiting.clear()
         return out
+
+    # -- SLO guardrails ------------------------------------------------------
+
+    def _record_shed(self, request: Request, reason: str,
+                     preemptions: int | None = None) -> None:
+        self.shed[request.rid] = ShedRecord(
+            rid=request.rid, reason=reason, shed_at=self.clock(),
+            preemptions=request.preemptions if preemptions is None
+            else preemptions)
+
+    def shed_expired(self) -> int:
+        """Shed every waiting or active request whose deadline has passed,
+        oldest (queue head / earliest-admitted slot) first. Runs at submit
+        overload and at the top of every step, so a request past its
+        deadline can never be admitted or produce a late result."""
+        now = self.clock()
+        n = 0
+        keep: deque[Request] = deque()
+        while self.waiting:
+            req = self.waiting.popleft()
+            if req.deadline is not None and now > req.deadline:
+                self._record_shed(req, "deadline")
+                n += 1
+            else:
+                keep.append(req)
+        self.waiting = keep
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            dl = slot.request.deadline
+            if dl is not None and now > dl:
+                self.cache.free(slot.alloc, cache_prefix=False)
+                self.slots[i] = None
+                self._record_shed(slot.request, "deadline",
+                                  preemptions=slot.preemptions)
+                n += 1
+        return n
+
+    def shed_waiting(self, reason: str) -> int:
+        """Shed the entire waiting queue (the ``shed_storm`` fault)."""
+        n = len(self.waiting)
+        while self.waiting:
+            self._record_shed(self.waiting.popleft(), reason)
+        return n
+
+    def load_report(self) -> dict:
+        """Backpressure signals a replica publishes to the KV store."""
+        now = self.clock()
+        cache = self.cache
+        return {
+            "queue_depth": len(self.waiting),
+            "active": self.active_requests,
+            "max_batch": self.config.max_batch,
+            "free_block_frac": cache.free_blocks / cache.config.num_blocks,
+            "steps": self.steps,
+            "step_age": None if self.last_step_at is None
+            else now - self.last_step_at,
+            "shed": len(self.shed),
+            "done": len(self.results),
+        }
 
     # -- shared mechanics ----------------------------------------------------
 
@@ -174,9 +280,22 @@ class _EngineBase:
         slot = _Slot(request=request, alloc=alloc, tokens=list(request.prompt),
                      preemptions=request.preemptions)
         self.slots[slot_idx] = slot
-        self._emit_token(slot, int(np.asarray(next_logits).argmax()))
+        self._emit_token(slot, self._pick_token(slot, np.asarray(next_logits)))
         if self._finished(slot):
             self._retire(slot_idx)
+
+    def _pick_token(self, slot: _Slot, logits_row: np.ndarray) -> int:
+        """Greedy argmax, or sampled via a key derived from (request seed,
+        decode-step index). The step index is ``len(slot.generated)`` — on
+        requeue the request replays from its original prompt, so every
+        re-draw folds the same index into the same key and the sampled
+        trajectory is bitwise identical to the unfaulted run."""
+        req = slot.request
+        if req.temperature <= 0.0:
+            return int(logits_row.argmax())
+        return sample_token(logits_row, seed=req.seed,
+                            step_index=len(slot.generated),
+                            temperature=req.temperature, top_k=req.top_k)
 
     def _emit_token(self, slot: _Slot, token: int) -> None:
         now = self.clock()
@@ -199,6 +318,11 @@ class _EngineBase:
         self.slots[i] = None
         self.cache.free(slot.alloc)
         req = slot.request
+        if req.deadline is not None and self.clock() > req.deadline:
+            # finished, but past the promise: the verdict is SHED, never a
+            # late result
+            self._record_shed(req, "deadline", preemptions=slot.preemptions)
+            return
         self.results[req.rid] = RequestResult(
             rid=req.rid, tokens=list(slot.generated),
             ttft=slot.first_token_at - req.arrival,
@@ -260,10 +384,11 @@ class _EngineBase:
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables))
         logits = np.asarray(logits)
         self.steps += 1
+        self.last_step_at = self.clock()
         for i in live:
             slot = self.slots[i]
             slot.alloc.length = len(slot.tokens)
-            self._emit_token(slot, int(logits[i].argmax()))
+            self._emit_token(slot, self._pick_token(slot, logits[i]))
             if self._finished(slot):
                 self._retire(i)
 
@@ -273,6 +398,7 @@ class ContinuousEngine(_EngineBase):
     the next step, nothing waits for a batch to finish."""
 
     def step(self) -> None:
+        self.shed_expired()
         while self.waiting:
             if not self._try_admit(self.waiting[0]):
                 break
@@ -285,6 +411,7 @@ class StaticEngine(_EngineBase):
     member finishes before admitting again."""
 
     def step(self) -> None:
+        self.shed_expired()
         if self.active_requests == 0:
             while self.waiting and self._try_admit(self.waiting[0]):
                 self.waiting.popleft()

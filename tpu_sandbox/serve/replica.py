@@ -10,9 +10,19 @@ the whole request plane:
     serve/lease/<rid>    TTL heartbeat while a replica works the request
     serve/scavenged/<n>  claim-once marker so an orphaned entry is
                          requeued exactly once
-    serve/result/<rid>   result body — idempotent (greedy decode over
-                         bitwise-deterministic steps: every execution of a
-                         request writes identical bytes)
+    serve/result/<rid>   terminal verdict — a token result or an explicit
+                         SHED body; idempotent for results (greedy or
+                         seeded-sampled decode over bitwise-deterministic
+                         steps: every execution of a request writes
+                         identical bytes)
+    serve/done/<rid>     claim-once verdict marker: the first publisher
+                         (result or SHED) wins, so a request reaches
+                         exactly one terminal verdict even when a shed
+                         races a scavenged duplicate execution
+    serve/load/<tag>     TTL'd per-replica load report (queue depth,
+                         block-pool pressure, decode-step lag) — the
+                         autoscaler's input
+    serve/cmd/<tag>      fault mailbox (shed_storm / stall_replica)
     serve/total          number of distinct requests the producer will pose
 
 Loss cases and their answers:
@@ -54,34 +64,56 @@ def k_req(rid: str) -> str:
     return f"serve/req/{rid}"
 
 
-def k_queue(n: int) -> str:
-    return f"serve/queue/{n}"
+def k_queue(seq: int) -> str:
+    return f"serve/queue/{seq}"
 
 
-def k_claim(n: int) -> str:
-    return f"serve/claim/{n}"
+def k_claim(seq: int) -> str:
+    return f"serve/claim/{seq}"
 
 
 def k_lease(rid: str) -> str:
     return f"serve/lease/{rid}"
 
 
-def k_scavenged(n: int) -> str:
-    return f"serve/scavenged/{n}"
+def k_scavenged(seq: int) -> str:
+    return f"serve/scavenged/{seq}"
 
 
 def k_result(rid: str) -> str:
     return f"serve/result/{rid}"
 
 
+def k_done(rid: str) -> str:
+    return f"serve/done/{rid}"
+
+
+def k_load(tag: str) -> str:
+    return f"serve/load/{tag}"
+
+
+def k_cmd(tag: str) -> str:
+    return f"serve/cmd/{tag}"
+
+
 # -- producer side -----------------------------------------------------------
 
 
 def submit_request(kv, rid: str, prompt: Sequence[int],
-                   max_new_tokens: int) -> None:
-    kv.set(k_req(rid), json.dumps(
-        {"rid": rid, "prompt": list(map(int, prompt)),
-         "max_new_tokens": int(max_new_tokens)}))
+                   max_new_tokens: int, *, deadline_unix: float | None = None,
+                   temperature: float = 0.0, top_k: int = 0,
+                   seed: int = 0) -> None:
+    """Queue one request. ``deadline_unix`` is wall clock (``time.time()``)
+    so it survives the hop between client and replica processes; replicas
+    translate it to their engine clock at claim time."""
+    body = {"rid": rid, "prompt": list(map(int, prompt)),
+            "max_new_tokens": int(max_new_tokens)}
+    if deadline_unix is not None:
+        body["deadline_unix"] = float(deadline_unix)
+    if temperature > 0.0:
+        body.update(temperature=float(temperature), top_k=int(top_k),
+                    seed=int(seed))
+    kv.set(k_req(rid), json.dumps(body))
     enqueue(kv, rid)
 
 
@@ -112,6 +144,16 @@ def read_result(kv, rid: str, timeout: float = 60.0) -> dict:
     raise TimeoutError(f"no result for {rid} within {timeout}s")
 
 
+def read_load_reports(kv) -> dict[str, dict]:
+    """Current (unexpired) per-replica load reports, keyed by replica tag."""
+    out = {}
+    for key in kv.keys("serve/load/"):
+        raw = kv.try_get(key)
+        if raw is not None:
+            out[key[len("serve/load/"):]] = json.loads(raw)
+    return out
+
+
 # -- replica side ------------------------------------------------------------
 
 
@@ -121,6 +163,8 @@ class ReplicaStats:
     completed: int = 0
     requeued: int = 0
     scavenged: int = 0
+    shed: int = 0
+    stalls: int = 0
 
 
 class ReplicaWorker:
@@ -131,16 +175,19 @@ class ReplicaWorker:
 
     def __init__(self, kv: KVClient, engine, *, tag: str = "replica",
                  lease_ttl: float = 3.0, claim_depth: int | None = None,
-                 scavenge_interval: float | None = None):
+                 scavenge_interval: float | None = None,
+                 load_interval: float | None = None):
         self.kv = kv
         self.engine = engine
         self.tag = tag
         self.lease_ttl = lease_ttl
         self.claim_depth = claim_depth or 2 * engine.config.max_batch
         self.scavenge_interval = scavenge_interval or lease_ttl
+        self.load_interval = load_interval or lease_ttl / 2
         self._scanned = 0
         self._published: set[str] = set()
         self._next_scavenge = time.monotonic() + self.scavenge_interval
+        self._next_load = 0.0  # publish on the first tick
         self.stats = ReplicaStats()
         self._draining = False
 
@@ -161,6 +208,7 @@ class ReplicaWorker:
             return False
         if results_done(self.kv):
             return False
+        self._poll_faults()
         tail = int(self.kv.try_get(K_TAIL) or b"0")
         while self._scanned < tail and self._local_load() < self.claim_depth:
             n = self._scanned
@@ -178,15 +226,18 @@ class ReplicaWorker:
             if self.kv.add(k_claim(n)) != 1:
                 continue
             body = json.loads(self.kv.get(k_req(rid)))
-            self.engine.submit(Request(
-                rid=rid, prompt=body["prompt"],
-                max_new_tokens=body["max_new_tokens"],
-                arrival=self.engine.clock()))
+            # a rid can come around again legitimately: a client that saw
+            # our SHED verdict cleared it and re-enqueued. Forget that we
+            # published, so the fresh execution's verdict goes out too
+            # (the claim-once serve/done marker still arbitrates races).
+            self._published.discard(rid)
+            self.engine.submit(self._to_request(body))
             self.stats.claimed += 1
         if not self.engine.idle:
             self.engine.step()
         self._heartbeat()
         self._publish_new()
+        self._publish_load()
         if time.monotonic() >= self._next_scavenge:
             self._next_scavenge = time.monotonic() + self.scavenge_interval
             self.scavenge()
@@ -200,8 +251,44 @@ class ReplicaWorker:
             if self.engine.idle:
                 time.sleep(poll)
 
+    def _to_request(self, body: dict):
+        """Queue-entry body -> engine Request, translating the wall-clock
+        deadline into this engine's clock (monotonic clocks don't travel
+        between processes, wall clock does)."""
+        from tpu_sandbox.serve.engine import Request
+
+        deadline = None
+        if body.get("deadline_unix") is not None:
+            deadline = self.engine.clock() \
+                + (float(body["deadline_unix"]) - time.time())
+        return Request(
+            rid=body["rid"], prompt=body["prompt"],
+            max_new_tokens=body["max_new_tokens"],
+            arrival=self.engine.clock(), deadline=deadline,
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            seed=int(body.get("seed", 0)))
+
+    def _poll_faults(self) -> None:
+        """Consume the replica fault mailbox (serve/cmd/<tag>): shed_storm
+        sheds the local waiting queue, stall_replica freezes this poll
+        loop long enough for leases to lapse (peers scavenge the claims)."""
+        raw = self.kv.try_get(k_cmd(self.tag))
+        if raw is None:
+            return
+        self.kv.delete(k_cmd(self.tag))
+        cmd = json.loads(raw)
+        action = cmd.get("action")
+        if action == "shed_storm":
+            self.stats.shed += self.engine.shed_waiting("fault:shed_storm")
+        elif action == "stall_replica":
+            self.stats.stalls += 1
+            time.sleep(float(cmd.get("duration", 2 * self.lease_ttl)))
+
     def drain(self) -> int:
-        """Requeue everything in flight; the SIGTERM path."""
+        """Requeue everything in flight; the SIGTERM path. Finished-but-
+        unpublished verdicts go out first so nothing computed is lost."""
+        self._publish_new()
         requests = self.engine.drain_to_requests()
         for req in requests:
             if req.rid in self._published or \
@@ -247,12 +334,38 @@ class ReplicaWorker:
         for rid, res in self.engine.results.items():
             if rid in self._published:
                 continue
-            self.kv.set(k_result(rid), json.dumps(
-                {"rid": rid, "tokens": res.tokens,
-                 "preemptions": res.preemptions, "replica": self.tag}))
-            self.kv.delete(k_lease(rid))
-            self._published.add(rid)
+            self._publish_verdict(rid, {
+                "rid": rid, "verdict": "ok", "tokens": res.tokens,
+                "preemptions": res.preemptions, "replica": self.tag})
             self.stats.completed += 1
+        for rid, rec in self.engine.shed.items():
+            if rid in self._published:
+                continue
+            self._publish_verdict(rid, {
+                "rid": rid, "verdict": "SHED", "reason": rec.reason,
+                "preemptions": rec.preemptions, "replica": self.tag})
+            self.stats.shed += 1
+
+    def _publish_verdict(self, rid: str, body: dict) -> None:
+        """Exactly-one-verdict: the first publisher claims serve/done/<rid>
+        and writes the result slot; a loser (a shed racing a scavenged
+        duplicate's result, or vice versa) leaves the winner's verdict
+        alone. Result bodies are identical across executions, so which ok
+        writer wins is unobservable."""
+        if self.kv.add(k_done(rid)) == 1:
+            self.kv.set(k_result(rid), json.dumps(body))
+        self.kv.delete(k_lease(rid))
+        self._published.add(rid)
+
+    def _publish_load(self) -> None:
+        now = time.monotonic()
+        if now < self._next_load:
+            return
+        self._next_load = now + self.load_interval
+        report = dict(self.engine.load_report(), tag=self.tag,
+                      wall=time.time())
+        self.kv.set_ttl(k_load(self.tag), json.dumps(report),
+                        max(3 * self.load_interval, self.lease_ttl))
 
 
 # -- worker process main -----------------------------------------------------
@@ -279,6 +392,7 @@ def _build_engine(cfg: dict):
         cache=CacheConfig(**cfg.get("cache", {})),
         max_batch=cfg.get("max_batch", 4),
         buckets=tuple(cfg.get("buckets", (16, 32))),
+        max_waiting=cfg.get("max_waiting", 0),
     )
     return ContinuousEngine(params, scfg)
 
