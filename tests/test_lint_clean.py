@@ -280,7 +280,9 @@ def test_graftlint_cli_traces_all_steps():
     assert report["unused_suppressions"] == 0
     hlo = report["hlo"]
     for step in ("dp", "zero", "pjit", "pipeline", "dp-int8",
-                 "dp-overlap", "sp", "decode", "prefill", "prefill-b16"):
+                 "dp-overlap", "sp", "decode", "prefill", "prefill-b16",
+                 "fsdp", "tp", "ep", "mpmd-s0-fwd", "mpmd-s0-bwd",
+                 "mpmd-s1-loss_grad"):
         assert hlo[step]["status"] == "traced", hlo
 
 

@@ -1,0 +1,170 @@
+"""Two-stage MPMD pipeline as real scheduler jobs (slow): per-stage
+worker processes co-admitted as a cogroup, activations/grads over the
+KV-store transport, faults landing MID-SHIPMENT.
+
+The receipts: after a stage host is SIGKILLed halfway through a step's
+op list (half its slots shipped), the scheduler respawns the worker,
+which restores its own HostCheckpoint, bumps the claim generation, and
+replays from durable slots — final params BITWISE identical to an
+unfaulted in-process run, per-step losses identical, and the claim-once
+audit shows zero duplicate deliveries in any generation. A network
+partition (paused heartbeats, stalled shipments) must heal with no
+relaunch at all.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_sandbox.runtime.faults import FaultPlan
+from tpu_sandbox.runtime.scheduler import ClusterScheduler, JobSpec
+
+PY = sys.executable
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {"PYTHONPATH": ROOT}
+
+MODEL = {"vocab_size": 64, "d_model": 32, "n_heads": 2, "n_layers": 4,
+         "d_ff": 64, "max_len": 64}
+OPTIMIZER = {"name": "adam", "lr": 0.01}
+BATCH = [8, 16]
+STEPS = 8
+M = 4
+SEED = 0
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Unfaulted in-process 2-stage run with the exact init derivation the
+    workers use (plan-seeded rng for data, seeded TransformerLM init)."""
+    import optax
+
+    from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
+    from tpu_sandbox.mpmd import MPMDPipeline
+
+    cfg = TransformerConfig(**MODEL)
+    rng = np.random.default_rng(SEED)
+    tokens = rng.integers(0, cfg.vocab_size, size=tuple(BATCH)).astype(
+        np.int32)
+    targets = ((tokens + 7) % cfg.vocab_size).astype(np.int32)
+    flat = jax.tree.map(
+        np.asarray,
+        TransformerLM(cfg).init(jax.random.key(SEED), tokens)["params"])
+    pipe = MPMDPipeline(cfg, optax.adam(OPTIMIZER["lr"]), n_stages=2,
+                        microbatches=M)
+    pipe.init_from_flat(flat)
+    losses = pipe.train(STEPS, tokens, targets)
+    return {
+        "losses": losses,
+        "stage_leaves": {
+            s: [np.asarray(x) for x in
+                jax.tree.leaves(pipe.workers[s].host_state()["params"])]
+            for s in (0, 1)
+        },
+    }
+
+
+def _json_arg(obj):
+    # agent_argv elements are str.format templates: JSON braces must be
+    # doubled so they survive placeholder substitution
+    return json.dumps(obj).replace("{", "{{").replace("}", "}}")
+
+
+def _stage_argv(stage, ckpt_root):
+    argv = [PY, "-m", "tpu_sandbox.mpmd.worker",
+            "{agent_id}", "{kv_port}", "{job_id}",
+            "--stage", str(stage), "--ckpt-root", str(ckpt_root),
+            "--get-timeout", "120"]
+    if stage == 0:  # the leader publishes the plan everyone else fetches
+        argv += ["--steps", str(STEPS), "--n-stages", "2",
+                 "--microbatches", str(M), "--seed", str(SEED),
+                 "--model", _json_arg(MODEL),
+                 "--optimizer", _json_arg(OPTIMIZER),
+                 "--batch", _json_arg(BATCH)]
+    return argv
+
+
+def _run_pipeline(tmp_path, fault_env_stage1):
+    with ClusterScheduler(2, poll=0.05, extra_env=ENV,
+                          verbose=False) as sched:
+        for s in (0, 1):
+            sched.submit(JobSpec(
+                job_id=f"stage{s}", hosts=1, world_size=1, cogroup="pipe0",
+                agent_argv=_stage_argv(s, tmp_path / "ckpt"),
+                admission_timeout=120.0,
+                env=fault_env_stage1 if s == 1 else {}))
+        states = sched.serve(timeout=300)
+        assert states == {"stage0": "done", "stage1": "done"}, states
+
+        from tpu_sandbox.mpmd.transport import KVTransport
+
+        tr = KVTransport(sched.kv, prefix="mpmd/pipe0/")
+        finals = {s: tr.get("final", 0, s, timeout=10.0) for s in (0, 1)}
+        losses = json.loads(sched.kv.get("mpmd/pipe0/losses"))
+        audit = tr.audit()
+        generations = {s: int(sched.kv.get(f"mpmd/pipe0/gen/{s}"))
+                       for s in (0, 1)}
+        # the job-done verdicts survive in the raw store summary only via
+        # states above; namespaces are swept — transport plane must not be
+        assert sched.kv.keys("job/stage0/") == []
+    return finals, losses, audit, generations
+
+
+def _assert_bitwise(reference, finals):
+    for s in (0, 1):
+        ref, got = reference["stage_leaves"][s], finals[s]
+        assert len(ref) == len(got)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes(), \
+                f"stage {s} leaf {i} differs from unfaulted run"
+
+
+def test_two_stage_pipeline_clean_run_matches_inprocess(tmp_path, reference):
+    finals, losses, audit, gens = _run_pipeline(tmp_path, {})
+    _assert_bitwise(reference, finals)
+    np.testing.assert_allclose(losses, reference["losses"], rtol=0,
+                               atol=1e-6)
+    assert gens == {0: 1, 1: 1}
+    dup = {k: v for k, v in audit["claims"].items() if v != 1}
+    assert not dup, f"duplicate deliveries: {dup}"
+
+
+def test_two_stage_pipeline_stage_kill_recovers_bitwise(tmp_path, reference):
+    """kill_agent fires mid-schedule on stage 1 at step 3: half the step's
+    slots are shipped when the host dies. The scheduler respawn + durable
+    slots + claim-generation bump must land bitwise with zero duplicate
+    and zero lost microbatches."""
+    plan = FaultPlan().add(rank=1, step=3, action="kill_agent")
+    finals, losses, audit, gens = _run_pipeline(
+        tmp_path, {"TPU_SANDBOX_FAULT_PLAN": plan.to_json()})
+    _assert_bitwise(reference, finals)
+    np.testing.assert_allclose(losses, reference["losses"], rtol=0,
+                               atol=1e-6)
+    # the kill really happened: stage 1 is on its second claim generation
+    assert gens == {0: 1, 1: 2}
+    dup = {k: v for k, v in audit["claims"].items() if v != 1}
+    assert not dup, f"duplicate deliveries: {dup}"
+    # replay actually re-claimed under the new generation
+    assert any(k.startswith("2/") for k in audit["claims"])
+
+
+def test_two_stage_pipeline_partition_heals_without_relaunch(tmp_path,
+                                                             reference):
+    """partition_host silences stage 1's heartbeats and stalls it for
+    1.5s mid-shipment; peers block on the transport and the schedule
+    simply resumes — no respawn, no new generation, same bits."""
+    plan = FaultPlan().add(rank=1, step=2, action="partition_host",
+                           target="1.5")
+    finals, losses, audit, gens = _run_pipeline(
+        tmp_path, {"TPU_SANDBOX_FAULT_PLAN": plan.to_json()})
+    _assert_bitwise(reference, finals)
+    np.testing.assert_allclose(losses, reference["losses"], rtol=0,
+                               atol=1e-6)
+    assert gens == {0: 1, 1: 1}  # the partition healed in place
+    dup = {k: v for k, v in audit["claims"].items() if v != 1}
+    assert not dup, f"duplicate deliveries: {dup}"

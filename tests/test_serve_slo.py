@@ -409,6 +409,37 @@ def test_autoscaler_hysteresis_resets_on_mixed_signal(kv_pair):
     assert ev and ev["action"] == "scale_up"
 
 
+def test_autoscaler_prewarms_compile_cache(kv_pair, tmp_path):
+    """Satellite: scale-ups point every replica at one shared XLA compile
+    cache, and each event records whether the new replica finds it warm
+    (deserialize executables) or cold (first compile pays full price)."""
+    from tpu_sandbox.runtime.scheduler import JobSpec, k_spec
+    from tpu_sandbox.serve.autoscale import (AutoscaleConfig,
+                                             ReplicaAutoscaler)
+
+    _, kv = kv_pair
+    cache = tmp_path / "xla-cache"
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=2, hysteresis_ticks=1,
+                          cooldown_s=0.0, compile_cache_dir=str(cache))
+    a = ReplicaAutoscaler(kv, ARGV, cfg=cfg)
+    ev = a.tick()  # bootstrap replica: nothing cached yet
+    assert ev and ev["compile_cache"] == "cold"
+    spec = JobSpec.from_json(kv.try_get(k_spec(ev["job_id"])).decode())
+    assert spec.env["JAX_COMPILATION_CACHE_DIR"] == str(cache)
+    # the bootstrap replica compiled and persisted its executables
+    (cache / "xla_dump").write_bytes(b"cached executable")
+    _reports(kv, {"w0": 10.0})
+    ev = a.tick()  # load-driven scale-up reacts to a WARM cache
+    assert ev and ev["action"] == "scale_up"
+    assert ev["compile_cache"] == "warm"
+    spec = JobSpec.from_json(kv.try_get(k_spec(ev["job_id"])).decode())
+    assert spec.env["JAX_COMPILATION_CACHE_DIR"] == str(cache)
+    # no cache dir configured -> events say so instead of guessing
+    assert ReplicaAutoscaler(
+        kv, ARGV, cfg=AutoscaleConfig(), member_id="m9",
+    ).compile_cache_state() == "disabled"
+
+
 # -- sampling (satellite: replay-exact requeue) ------------------------------
 
 

@@ -74,7 +74,7 @@ def main(argv=None) -> int:
                         "(donation reported as skipped)")
     p.add_argument("--steps",
                    default="dp,zero,pjit,pipeline,dp-int8,dp-overlap,"
-                           "sp,decode,prefill",
+                           "sp,decode,prefill,fsdp,tp,ep,mpmd",
                    help="pass 2 step functions to trace")
     args = p.parse_args(argv)
 

@@ -359,6 +359,78 @@ def _trace_targets(steps) -> tuple[list[Finding], dict]:
                   jax.ShapeDtypeStruct((1, bucket), jnp.int32),
                   jax.ShapeDtypeStruct((bucket,), jnp.int32),
                   jax.ShapeDtypeStruct((), jnp.int32))
+    # second-wave engines (VERDICT: the lint only covers what it traces):
+    # FSDP-as-specs, the full Megatron TP ruleset, expert parallelism, and
+    # the per-stage MPMD programs each have collective/donation surfaces
+    # the first-wave steps never exercise
+    if "fsdp" in steps:
+        engf = PjitEngine(model, tx, mesh, fsdp_axis="data")
+        trace("fsdp", engf._build(state), state, imgs, labs)
+    if "tp" in steps:
+        from tpu_sandbox.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from tpu_sandbox.parallel.pjit_engine import megatron_rules
+
+        # every megatron-ruled dim divisible by the 4-way model axis
+        cfg_tp = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                   n_layers=2, d_ff=64, max_len=64)
+        mesh_tp = Mesh(devices.reshape(2, 4), ("data", "model"))
+        lm_tp = TransformerLM(cfg_tp)
+        engt = PjitEngine(lm_tp, tx, mesh_tp, task="lm",
+                          rules=megatron_rules("model"))
+        tstate = jax.eval_shape(lambda: TrainState.create(
+            lm_tp, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx))
+        ttoks = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+        trace("tp", engt._build(tstate), tstate, ttoks, ttoks)
+    if "ep" in steps:
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_sandbox.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg_ep = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                   n_layers=1, d_ff=64, max_len=64,
+                                   n_experts=4, capacity_factor=2.0)
+        mesh_ep = Mesh(devices.reshape(2, 4), ("data", "expert"))
+        lm_ep = TransformerLM(cfg_ep)
+        enge = PjitEngine(lm_ep, tx, mesh_ep, task="lm",
+                          rules=[(r"w_(up|down)", P("expert", None, None))])
+        estate = jax.eval_shape(lambda: TrainState.create(
+            lm_ep, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx))
+        etoks = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+        trace("ep", enge._build(estate), estate, etoks, etoks)
+    if "mpmd" in steps:
+        from tpu_sandbox.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from tpu_sandbox.mpmd.program import StageProgram, stage_params
+
+        cfg_m = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                  n_layers=4, d_ff=64, max_len=64)
+        # stage_params slices concrete leaves; a tiny real init is cheap
+        flat_m = jax.tree.map(np.asarray, TransformerLM(cfg_m).init(
+            jax.random.key(0), jnp.zeros((1, 16), jnp.int32))["params"])
+        for s in (0, 1):
+            prog = StageProgram(cfg_m, tx, s, 2, 2)
+            absp = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                stage_params(flat_m, s, 2))
+            if prog.is_first:
+                x = jax.ShapeDtypeStruct((4, 16), jnp.int32)
+            else:
+                x = jax.ShapeDtypeStruct((4, 16, cfg_m.d_model), cfg_m.dtype)
+            if prog.is_last:
+                trace(f"mpmd-s{s}-loss_grad", prog.loss_grad, absp, x,
+                      jax.ShapeDtypeStruct((4, 16), jnp.int32))
+            else:
+                trace(f"mpmd-s{s}-fwd", prog.fwd, absp, x)
+                g = jax.eval_shape(prog.fwd, absp, x)
+                trace(f"mpmd-s{s}-bwd", prog.bwd, absp, x, g)
     return findings, report
 
 
@@ -439,7 +511,7 @@ def _aot_targets(steps, *, topology: str, chips, overlap_check: bool,
 def run_hlo_pass(
     *,
     steps=("dp", "zero", "pjit", "pipeline", "dp-int8", "dp-overlap",
-           "sp", "decode", "prefill"),
+           "sp", "decode", "prefill", "fsdp", "tp", "ep", "mpmd"),
     aot: bool = True,
     topology: str = "v5e:2x2x1",
     chips=(2, 2, 1),

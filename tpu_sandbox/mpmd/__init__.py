@@ -1,0 +1,30 @@
+"""MPMD cross-mesh pipeline: per-stage programs, stage transport, 1F1B.
+
+The SPMD pipeline in ``parallel/pipeline.py`` is one compiled program on
+one mesh. This package is the "many cooperating meshes" shape (ROADMAP
+item 3, arxiv 2412.14374): each stage owns its own mesh and compiles only
+its own program; activations and cotangents ship between stages over a
+:class:`~tpu_sandbox.mpmd.transport.Transport`; a leader-published 1F1B
+schedule coordinates microbatch dispatch. Trained parameters are bitwise
+identical to the SPMD pipeline on the same model (see program.py for the
+accumulation-order discipline that makes this hold).
+"""
+
+from tpu_sandbox.mpmd.transport import (  # noqa: F401
+    KVTransport,
+    LocalTransport,
+    Transport,
+    TransportStats,
+    pack_arrays,
+    unpack_arrays,
+)
+from tpu_sandbox.mpmd.program import (  # noqa: F401
+    StageProgram,
+    merge_stage_params,
+    stage_params,
+)
+from tpu_sandbox.mpmd.schedule import (  # noqa: F401
+    bubble_fraction,
+    one_f_one_b,
+)
+from tpu_sandbox.mpmd.driver import MPMDPipeline, StageWorker  # noqa: F401

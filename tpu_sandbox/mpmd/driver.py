@@ -1,0 +1,373 @@
+"""Stage workers and the in-process MPMD pipeline harness.
+
+:class:`StageWorker` is the per-stage execution loop: it walks the
+stage's 1F1B op list, pulling activations/cotangents off the transport
+(claim-once), running the stage's compiled programs, shipping its own
+outputs, and applying the stage-local optimizer once per step with the
+descending-microbatch accumulation that keeps trained params bitwise
+equal to the SPMD pipeline. The same loop body backs both deployment
+shapes: :class:`MPMDPipeline` drives S workers on S single-device CPU
+meshes with one thread per stage (the tier-1 twin), and
+``mpmd/worker.py`` runs one worker per process under per-stage HostAgent
+gangs with a :class:`~tpu_sandbox.mpmd.transport.KVTransport`.
+
+Recovery model (the reason the transport is durable): a stage host that
+dies mid-step is relaunched, restores params/opt from its own
+single-writer :class:`~tpu_sandbox.train.checkpoint.HostCheckpoint`, and
+replays from the checkpointed step + 1. Replay re-ships slots the dead
+generation already produced (``put`` is an idempotent no-op on complete
+slots) and re-consumes its inputs under a NEW claim generation, while
+the surviving stages never rewind — the durable slots between the
+checkpoint watermark and the frontier bridge the gap. Slots are only
+garbage-collected (``release_step``) up to the minimum step every stage
+has made durable, so a replayer always finds its inputs. Because every
+F/B is a pure function of shipped values, the replayed lineage lands
+bitwise on the unfaulted run's parameters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from tpu_sandbox.mpmd.program import (
+    StageProgram,
+    accumulate_descending,
+    merge_stage_params,
+    stage_params,
+)
+from tpu_sandbox.mpmd.schedule import bubble_fraction, one_f_one_b
+from tpu_sandbox.mpmd.transport import EdgeNames, LocalTransport
+from tpu_sandbox.train.checkpoint import HostCheckpoint
+
+
+class StageKilled(RuntimeError):
+    """In-process stand-in for a stage-host crash: raised by the
+    ``fail_at`` hook mid-step, leaving half-shipped slots and an
+    un-applied optimizer step behind — exactly the state a kill_agent
+    fault leaves on the KV store in the process-level path."""
+
+
+class StageWorker:
+    """Executes one stage's schedule against a transport.
+
+    ``generation`` is the claim-once namespace: a relaunched worker for
+    the same stage MUST carry a higher generation so its replay can
+    re-consume slots the dead lineage already claimed.
+    """
+
+    def __init__(self, program: StageProgram, params, opt_state, transport,
+                 *, generation: int = 0, checkpoint: HostCheckpoint | None
+                 = None, get_timeout: float = 60.0):
+        self.program = program
+        self.transport = transport
+        self.generation = generation
+        self.checkpoint = checkpoint
+        self.get_timeout = get_timeout
+        self.params = program.place(params)
+        self.opt_state = (program.init_opt_state(self.params)
+                          if opt_state is None else program.place(opt_state))
+        # host-side restore template (checkpoints are structure-checked)
+        self._template = {
+            "params": jax.tree.map(np.asarray, params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+        }
+        self.ops = one_f_one_b(program.stage, program.n_stages,
+                               program.microbatches)
+        s = program.stage
+        self.act_in = EdgeNames(s - 1).act if not program.is_first else None
+        self.act_out = EdgeNames(s).act if not program.is_last else None
+        self.grad_in = EdgeNames(s).grad if not program.is_last else None
+        self.grad_out = EdgeNames(s - 1).grad if not program.is_first else None
+        self.next_step = 0
+        self.losses: dict[int, float] = {}
+        self.step_seconds: dict[int, float] = {}
+        self.applied_steps: list[int] = []
+        #: (step, op_index) at which to raise StageKilled — fault hook
+        self.fail_at: tuple[int, int] | None = None
+        #: optional callback run at every op boundary ``(step, op_index)``
+        #: — the process worker hangs its fault-plan trigger and agent
+        #: mailbox poll here, so agent faults land MID-shipment
+        self.on_op = None
+
+    # -- fault hook ----------------------------------------------------------
+
+    def _maybe_fail(self, step: int, op_index: int) -> None:
+        if self.fail_at is not None and self.fail_at == (step, op_index):
+            self.fail_at = None
+            raise StageKilled(
+                f"stage {self.program.stage} killed at step {step} "
+                f"op {op_index}")
+
+    def _consume(self, edge: str, step: int, mb: int) -> None:
+        if not self.transport.claim(edge, step, mb,
+                                    generation=self.generation):
+            raise RuntimeError(
+                f"duplicate delivery: stage {self.program.stage} "
+                f"generation {self.generation} already consumed "
+                f"{edge}/{step}/{mb}")
+
+    # -- one optimizer step --------------------------------------------------
+
+    def run_step(self, step: int, *, tokens=None, targets=None) -> None:
+        prog, tr = self.program, self.transport
+        M = prog.microbatches
+        if prog.is_first:
+            if tokens is None:
+                raise ValueError("stage 0 needs the token batch")
+            tokens_mb = np.asarray(tokens).reshape(
+                M, -1, np.shape(tokens)[-1])
+        if prog.is_last:
+            if targets is None:
+                raise ValueError("last stage needs the target batch")
+            targets_mb = np.asarray(targets).reshape(
+                M, -1, np.shape(targets)[-1])
+        stash: dict[int, object] = {}
+        per_mb: dict[int, object] = {}
+        loss = np.float32(0.0)
+        t0 = time.perf_counter()
+        for idx, (op, m) in enumerate(self.ops):
+            self._maybe_fail(step, idx)
+            if self.on_op is not None:
+                self.on_op(step, idx)
+            if op == "F":
+                if prog.is_first:
+                    x = prog.place(np.asarray(tokens_mb[m]))
+                else:
+                    self._consume(self.act_in, step, m)
+                    (h,) = tr.get(self.act_in, step, m,
+                                  timeout=self.get_timeout)
+                    x = prog.place(h)
+                stash[m] = x
+                if not prog.is_last:
+                    h_out = prog.fwd(self.params, x)
+                    tr.put(self.act_out, step, m, [np.asarray(h_out)])
+            else:
+                if prog.is_last:
+                    lv, gp, gh = prog.loss_grad(
+                        self.params, stash.pop(m),
+                        prog.place(np.asarray(targets_mb[m])))
+                    # ship the upstream cotangent before anything else:
+                    # the previous stage is waiting on it
+                    tr.put(self.grad_out, step, m, [np.asarray(gh)])
+                    loss = loss + np.float32(lv)
+                    per_mb[m] = jax.tree.map(np.asarray, gp)
+                else:
+                    self._consume(self.grad_in, step, m)
+                    (g,) = tr.get(self.grad_in, step, m,
+                                  timeout=self.get_timeout)
+                    gp, gx = prog.bwd(self.params, stash.pop(m),
+                                      prog.place(g))
+                    if not prog.is_first:
+                        tr.put(self.grad_out, step, m, [np.asarray(gx)])
+                    per_mb[m] = jax.tree.map(np.asarray, gp)
+        grads = accumulate_descending(per_mb)
+        self.params, self.opt_state = prog.apply_grads(
+            self.params, self.opt_state, prog.place(grads))
+        self.step_seconds[step] = time.perf_counter() - t0
+        if prog.is_last:
+            self.losses[step] = float(loss)
+        self.applied_steps.append(step)
+        self.next_step = step + 1
+
+    # -- durability ----------------------------------------------------------
+
+    def host_state(self) -> dict:
+        return {
+            "params": jax.tree.map(np.asarray, self.params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+        }
+
+    def save_checkpoint(self, step: int) -> None:
+        if self.checkpoint is not None:
+            self.checkpoint.save(self.host_state(), step, epoch=0, offset=0)
+
+    def restore_checkpoint(self) -> int | None:
+        """Restore params/opt from the newest valid checkpoint; returns
+        the restored step (``next_step`` becomes step + 1) or ``None``
+        for a fresh start (``next_step`` 0)."""
+        if self.checkpoint is None:
+            return None
+        out = self.checkpoint.restore(self._template)
+        if out is None:
+            self.next_step = 0
+            return None
+        state, meta = out
+        self.params = self.program.place(state["params"])
+        self.opt_state = self.program.place(state["opt_state"])
+        self.next_step = int(meta["step"]) + 1
+        return int(meta["step"])
+
+
+class MPMDPipeline:
+    """In-process MPMD harness: S stage workers, one per single-device
+    CPU mesh, one thread each, over a shared transport.
+
+    This is the tier-1 twin of the multi-process deployment: the same
+    StageWorker loop, the same transport contract, the same recovery
+    path — minus processes, agents and the scheduler. ``train`` runs
+    the leader loop: launch stage threads, advance the release
+    watermark (GC slots every stage has made durable), and — with
+    ``recover=True`` — relaunch any stage that dies with
+    :class:`StageKilled` from its checkpoint under a new claim
+    generation.
+    """
+
+    def __init__(self, config, tx, *, n_stages: int = 2,
+                 microbatches: int = 4, transport=None, devices=None,
+                 ckpt_root=None, get_timeout: float = 60.0):
+        self.config = config
+        self.tx = tx
+        self.n_stages = n_stages
+        self.microbatches = microbatches
+        self.transport = LocalTransport() if transport is None else transport
+        if devices is None:
+            devs = jax.devices()
+            devices = [devs[s % len(devs)] for s in range(n_stages)]
+        self.devices = devices
+        self.programs = [
+            StageProgram(config, tx, s, n_stages, microbatches,
+                         device=devices[s])
+            for s in range(n_stages)
+        ]
+        self.ckpt_root = ckpt_root
+        self.get_timeout = get_timeout
+        self.workers: list[StageWorker] = []
+        self._generations = [0] * n_stages
+        self._released_through = -1
+
+    # -- construction --------------------------------------------------------
+
+    def _checkpoint_for(self, stage: int) -> HostCheckpoint | None:
+        if self.ckpt_root is None:
+            return None
+        return HostCheckpoint(f"{self.ckpt_root}/stage-{stage}")
+
+    def init_from_flat(self, flat_params: dict) -> None:
+        """Build the stage workers from a full TransformerLM param tree
+        (e.g. ``PipelineParallel.merged_params`` of the same init — the
+        parity tests seed both engines identically this way)."""
+        self.workers = [
+            StageWorker(self.programs[s],
+                        stage_params(flat_params, s, self.n_stages),
+                        None, self.transport,
+                        checkpoint=self._checkpoint_for(s),
+                        get_timeout=self.get_timeout)
+            for s in range(self.n_stages)
+        ]
+
+    def init(self, rng, sample_tokens) -> None:
+        from tpu_sandbox.models.transformer import TransformerLM
+        flat = TransformerLM(self.config).init(rng, sample_tokens)["params"]
+        self.init_from_flat(jax.tree.map(np.asarray, flat))
+
+    # -- recovery ------------------------------------------------------------
+
+    def respawn_stage(self, stage: int) -> StageWorker:
+        """Relaunch a dead stage: fresh worker, params restored from the
+        stage's own checkpoint, claim generation bumped so replay can
+        re-consume already-claimed slots."""
+        old = self.workers[stage]
+        self._generations[stage] += 1
+        worker = StageWorker(
+            old.program, old._template["params"],
+            old._template["opt_state"], self.transport,
+            generation=self._generations[stage],
+            checkpoint=old.checkpoint, get_timeout=self.get_timeout)
+        worker.restore_checkpoint()
+        # carry the audit trail across the relaunch
+        worker.applied_steps = list(old.applied_steps)
+        worker.losses = dict(old.losses)
+        worker.step_seconds = dict(old.step_seconds)
+        self.workers[stage] = worker
+        return worker
+
+    # -- leader loop ---------------------------------------------------------
+
+    def _stage_loop(self, stage: int, steps: int, tokens, targets,
+                    done: list[int], errors: dict) -> None:
+        worker = self.workers[stage]
+        try:
+            for step in range(worker.next_step, steps):
+                worker.run_step(
+                    step,
+                    tokens=tokens if worker.program.is_first else None,
+                    targets=targets if worker.program.is_last else None)
+                worker.save_checkpoint(step)
+                done[stage] = step
+        except BaseException as e:  # noqa: BLE001 — reported to the leader
+            errors[stage] = e
+
+    def release_through(self, step: int) -> None:
+        """GC every edge's slots up to ``step`` inclusive (leader calls
+        this only once ALL stages have checkpointed past ``step`` — a
+        replayer never rewinds below its own checkpoint, so these slots
+        can no longer be re-read)."""
+        for s in range(self._released_through + 1, step + 1):
+            for edge in ([EdgeNames(i).act for i in range(self.n_stages - 1)]
+                         + [EdgeNames(i).grad
+                            for i in range(self.n_stages - 1)]):
+                self.transport.release_step(edge, s)
+        self._released_through = max(self._released_through, step)
+
+    def train(self, steps: int, tokens, targets, *, recover: bool = False,
+              release: bool = True) -> list[float]:
+        """Run the pipeline to ``steps`` optimizer steps on a fixed
+        batch; returns the per-step losses. With ``recover=True``,
+        stages dying with StageKilled are respawned from checkpoint and
+        the run continues to the same end state."""
+        if not self.workers:
+            raise RuntimeError("call init()/init_from_flat() first")
+        done = [w.next_step - 1 for w in self.workers]
+        errors: dict[int, BaseException] = {}
+
+        def launch(stage: int) -> threading.Thread:
+            t = threading.Thread(
+                target=self._stage_loop,
+                args=(stage, steps, tokens, targets, done, errors),
+                name=f"mpmd-stage-{stage}", daemon=True)
+            t.start()
+            return t
+
+        threads = {s: launch(s) for s in range(self.n_stages)}
+        while threads:
+            if release and self.ckpt_root is not None:
+                watermark = min(done)
+                if watermark > self._released_through:
+                    self.release_through(watermark)
+            for stage in list(threads):
+                threads[stage].join(timeout=0.01)
+                if threads[stage].is_alive():
+                    continue
+                del threads[stage]
+                err = errors.pop(stage, None)
+                if err is None:
+                    continue
+                if recover and isinstance(err, StageKilled):
+                    worker = self.respawn_stage(stage)
+                    done[stage] = worker.next_step - 1
+                    threads[stage] = launch(stage)
+                else:
+                    # surviving threads exit via their get() timeouts
+                    raise err
+        if errors:
+            raise next(iter(errors.values()))
+        if release:
+            self.release_through(steps - 1)
+        last = self.workers[-1]
+        return [last.losses[s] for s in sorted(last.losses)]
+
+    # -- results / metrics ---------------------------------------------------
+
+    def merged_params(self) -> dict:
+        return merge_stage_params([
+            jax.tree.map(np.asarray, w.params) for w in self.workers])
+
+    def bubble_fraction(self) -> float:
+        return bubble_fraction(self.n_stages, self.microbatches)
+
+    def stage_step_seconds(self) -> list[dict[int, float]]:
+        return [dict(w.step_seconds) for w in self.workers]

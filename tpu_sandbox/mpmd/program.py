@@ -1,0 +1,214 @@
+"""Per-stage MPMD programs — separately compiled, bitwise-matching the
+SPMD pipeline.
+
+Each stage compiles ONLY its own program (the AOT receipt in
+tools/aot_mpmd.py shows stage 0's executable carries the embedding table
+and no head, the last stage's the reverse): forward for its layer slice,
+a vjp-based backward fed by the downstream stage's shipped cotangent, and
+a stage-local optimizer apply. The math is lifted from
+``parallel/pipeline.py`` (same ``Block.apply`` scan, same fp32 layernorm,
+same ``head_loss/M``), so the only parity question is accumulation order.
+
+Bitwise discipline (held by tests/test_mpmd.py against the real SPMD
+engine on a ``{'data': 1, 'pipe': S}`` mesh, where psum/pmean are
+identities):
+
+- The SPMD pipeline differentiates one ``lax.scan`` over ticks; scan's
+  transpose accumulates each stage's parameter cotangent in REVERSE tick
+  order, i.e. descending microbatch. So per-microbatch stage grads here
+  are summed with a left fold in **descending** microbatch order —
+  ``((0 + g[M-1]) + g[M-2]) + ... + g[0]`` — which reproduces the scan
+  transpose add-for-add (``0 + g`` is bitwise ``g``).
+- The loss scalar is accumulated ascending (forward tick order), like
+  the scan carry. Trained *parameters* are bitwise across ≥20 steps for
+  sgd and adam; the reported *loss* can differ from the fused SPMD
+  program by ~1 ulp on some steps — XLA may group the cross-entropy mean
+  reduction differently in the two compilations, and a reduce regrouping
+  changes the forward value but not its gradient (the cotangent of a
+  mean is uniform regardless of grouping). Params are the parity
+  contract; losses are compared to 1e-6.
+- optax's sgd/adam update leaf-wise, so the stage-local apply over a
+  stage's param slice matches the SPMD whole-tree update exactly.
+  (Global-norm-clipped transforms would couple stages and break this —
+  callers wanting clipping must apply it per stage on both sides.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from tpu_sandbox.models.transformer import Block, TransformerConfig
+from tpu_sandbox.ops.losses import cross_entropy_loss
+from tpu_sandbox.parallel.pipeline import (
+    _layernorm,
+    merge_transformer_params,
+    split_transformer_params,
+)
+
+
+def stage_params(flat_params: dict, stage: int, n_stages: int) -> dict:
+    """Slice a full TransformerLM param tree to one stage's subtree:
+    ``{"stages": [lps, ...]}`` plus ``"pre"`` on stage 0 and ``"post"``
+    on the last stage — the same leaves the SPMD engine shards to that
+    pipe rank, so checkpoints interchange leaf-for-leaf."""
+    pre, stacked, post = split_transformer_params(flat_params, n_stages)
+    lps = jax.tree.leaves(stacked)[0].shape[0] // n_stages
+    sliced = jax.tree.map(
+        lambda x: np.asarray(x)[stage * lps:(stage + 1) * lps], stacked)
+    out = {"stages": sliced}
+    if stage == 0:
+        out["pre"] = jax.tree.map(np.asarray, pre)
+    if stage == n_stages - 1:
+        out["post"] = jax.tree.map(np.asarray, post)
+    return out
+
+
+def merge_stage_params(parts: list[dict]) -> dict:
+    """Per-stage param subtrees (stage order) -> flat TransformerLM tree."""
+    stacked = jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+        *[p["stages"] for p in parts])
+    return merge_transformer_params(
+        jax.tree.map(np.asarray, parts[0]["pre"]), stacked,
+        jax.tree.map(np.asarray, parts[-1]["post"]))
+
+
+def tree_add(a, b):
+    """Elementwise host add — the accumulation op of the scan transpose
+    (IEEE fp32 add is the same bit pattern on host numpy and XLA:CPU)."""
+    return jax.tree.map(lambda x, y: np.asarray(x) + np.asarray(y), a, b)
+
+
+def tree_zeros_like(t):
+    return jax.tree.map(lambda x: np.zeros_like(np.asarray(x)), t)
+
+
+def accumulate_descending(grads_by_mb: dict):
+    """Left-fold per-microbatch grads in descending microbatch order —
+    the scan-transpose order (module docstring). ``grads_by_mb`` maps
+    microbatch index -> grad tree and must be dense over [0, M)."""
+    order = sorted(grads_by_mb, reverse=True)
+    acc = tree_zeros_like(grads_by_mb[order[0]])
+    for m in order:
+        acc = tree_add(acc, grads_by_mb[m])
+    return acc
+
+
+class StageProgram:
+    """Compiled step functions for one pipeline stage.
+
+    ``device`` pins the stage to its own mesh: every jitted call runs
+    where its (committed) params live, so N stages on one process give
+    N separate single-device meshes each executing only its own
+    executable — the CPU twin of one mesh per stage-gang.
+    """
+
+    def __init__(self, config: TransformerConfig,
+                 tx: optax.GradientTransformation, stage: int,
+                 n_stages: int, microbatches: int, *, device=None):
+        if config.n_layers % n_stages:
+            raise ValueError(
+                f"{config.n_layers} layers not divisible into "
+                f"{n_stages} stages")
+        self.config = config
+        self.tx = tx
+        self.stage = stage
+        self.n_stages = n_stages
+        self.microbatches = microbatches
+        self.device = device
+        self.is_first = stage == 0
+        self.is_last = stage == n_stages - 1
+        self._block = Block(config, None)
+        self._build()
+
+    # -- the per-stage math (identical to parallel/pipeline.py) -------------
+
+    def _stage_apply(self, sp, h):
+        def one(hh, layer_params):
+            return self._block.apply({"params": layer_params}, hh), None
+
+        out, _ = lax.scan(one, h, sp)
+        return out
+
+    def _embed(self, pre, tokens):
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+        tok = pre["tok_emb"]["embedding"][tokens]
+        pos = pre["pos_emb"]["embedding"][positions]
+        return (tok + pos).astype(self.config.dtype)
+
+    def _head_loss(self, post, h, targets):
+        dt = self.config.dtype
+        hn = _layernorm(h, post["ln_f"]).astype(dt)
+        logits = (hn @ post["lm_head"]["kernel"].astype(dt)
+                  + post["lm_head"]["bias"].astype(dt))
+        return cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
+
+    def _forward(self, params, x):
+        h = self._embed(params["pre"], x) if self.is_first else x
+        return self._stage_apply(params["stages"], h)
+
+    # -- compiled entry points ----------------------------------------------
+
+    def _build(self) -> None:
+        M = self.microbatches
+
+        def fwd(params, x):
+            return self._forward(params, x)
+
+        def bwd(params, x, g_out):
+            # recompute-forward + transpose, exactly what the SPMD scan's
+            # remat backward does for this tick
+            if self.is_first:
+                _, vjp = jax.vjp(lambda p: self._forward(p, x), params)
+                return vjp(g_out)[0], None
+            _, vjp = jax.vjp(self._forward, params, x)
+            return vjp(g_out)
+
+        def loss_grad(params, x, targets):
+            def f(p, xx):
+                out = self._forward(p, xx)  # reads pre/stages only
+                return self._head_loss(p["post"], out, targets) / M
+
+            lv, grads = jax.value_and_grad(f, argnums=(0, 1))(params, x)
+            return lv, grads[0], grads[1]
+
+        def apply_grads(params, opt_state, grads):
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self.fwd = jax.jit(fwd)
+        self.bwd = jax.jit(bwd)
+        self.loss_grad = jax.jit(loss_grad)
+        self.apply_grads = jax.jit(apply_grads)
+
+    # -- placement ----------------------------------------------------------
+
+    def place(self, tree):
+        """Commit a pytree to this stage's device (jit dispatch follows
+        committed operands, so the stage's programs execute on its mesh)."""
+        if self.device is None:
+            return tree
+        return jax.device_put(tree, self.device)
+
+    def init_opt_state(self, params):
+        return self.place(self.tx.init(params))
+
+    def lower_train_programs(self, params, sample_x, sample_targets=None):
+        """AOT-lower this stage's programs (fwd and, where they exist,
+        bwd/loss_grad) without executing — the hook aot_mpmd.py and the
+        graftlint HLO pass share."""
+        out = {}
+        if self.is_last:
+            out["loss_grad"] = self.loss_grad.lower(
+                params, sample_x, sample_targets)
+        else:
+            out["fwd"] = self.fwd.lower(params, sample_x)
+            g = jax.eval_shape(self.fwd, params, sample_x)
+            out["bwd"] = self.bwd.lower(params, sample_x, g)
+        return out

@@ -1,0 +1,100 @@
+"""Leader-driven global schedule: 1F1B op lists per stage.
+
+The leader computes every stage's op list once and publishes it (KV for
+the distributed path, direct handoff in-process); stages execute their
+list mechanically — all cross-stage coordination is the transport's
+blocking slot waits, so the schedule needs no per-tick control messages.
+
+1F1B: stage ``i`` runs ``min(M, S - 1 - i)`` warmup forwards, then
+alternates F/B until forwards are spent, then drains backwards. Same
+bubble as GPipe — ``(S-1)/(M+S-1)`` — but in-flight activations are
+bounded by S instead of M, which is what lets a stage stash at most
+``S - i`` microbatch inputs regardless of M.
+
+Values are schedule-independent: every F/B is a pure program on shipped
+inputs, so any topological order of the dependency dag gives bitwise
+identical grads. 1F1B is about memory and bubble, not numerics — which
+is also why the recovery path may replay a step with a plain
+F*-then-B* order and still land bitwise on the unfaulted state.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def one_f_one_b(stage: int, n_stages: int,
+                microbatches: int) -> list[tuple[str, int]]:
+    """The stage's op list: [("F", mb) | ("B", mb), ...]."""
+    if not 0 <= stage < n_stages:
+        raise ValueError(f"stage {stage} not in [0, {n_stages})")
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
+    warmup = min(microbatches, n_stages - 1 - stage)
+    ops: list[tuple[str, int]] = [("F", m) for m in range(warmup)]
+    nf, nb = warmup, 0
+    while nb < microbatches:
+        if nf < microbatches:
+            ops.append(("F", nf))
+            nf += 1
+        ops.append(("B", nb))
+        nb += 1
+    return ops
+
+
+def max_in_flight(ops: list[tuple[str, int]]) -> int:
+    """Peak number of microbatches forwarded but not yet backwarded —
+    the stage's activation-stash bound (S - stage for 1F1B)."""
+    live = peak = 0
+    for op, _ in ops:
+        live += 1 if op == "F" else -1
+        peak = max(peak, live)
+    return peak
+
+
+def bubble_fraction(n_stages: int, microbatches: int) -> float:
+    """(S-1)/(M+S-1): idle fraction of the synchronous schedule; same
+    formula as ``PipelineParallel.bubble_fraction`` at v=1."""
+    return (n_stages - 1) / (microbatches + n_stages - 1)
+
+
+# -- leader publication (distributed path) ----------------------------------
+
+def plan_key(prefix: str) -> str:
+    return f"{prefix}/plan" if prefix else "mpmd/plan"
+
+
+def publish_plan(kv, *, n_stages: int, microbatches: int, steps: int,
+                 seed: int, prefix: str = "mpmd",
+                 extra: dict | None = None) -> dict:
+    """The leader's one-shot schedule publication: each stage reads its
+    own op list and the run geometry from a single durable key, so a
+    relaunched stage host rejoins the SAME global schedule (the plan,
+    like the queue, outlives any process). ``extra`` rides along for
+    run config the stages must agree on (model, optimizer, batch)."""
+    plan = {
+        "n_stages": n_stages,
+        "microbatches": microbatches,
+        "steps": steps,
+        "seed": seed,
+        "ops": {str(s): one_f_one_b(s, n_stages, microbatches)
+                for s in range(n_stages)},
+    }
+    plan.update(extra or {})
+    kv.set(plan_key(prefix), json.dumps(plan))
+    return plan
+
+
+def fetch_plan(kv, *, prefix: str = "mpmd", timeout: float = 60.0) -> dict:
+    import time
+    deadline = time.monotonic() + timeout
+    raw = kv.try_get(plan_key(prefix))
+    while raw is None:
+        if time.monotonic() >= deadline:
+            raise TimeoutError("no schedule plan published")
+        time.sleep(0.01)
+        raw = kv.try_get(plan_key(prefix))
+    plan = json.loads(raw)
+    plan["ops"] = {int(k): [tuple(op) for op in v]
+                   for k, v in plan["ops"].items()}
+    return plan

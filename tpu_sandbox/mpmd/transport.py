@@ -1,0 +1,341 @@
+"""Stage transport: device-buffer shipment of activations/grads between
+meshes.
+
+The first wire is the KV store (control plane) + host-RAM staging: a
+producer stages its device buffer to host bytes, chunks them under the
+store's read cap, and publishes a seq-numbered slot; the consumer blocks
+on the slot's meta key, reassembles, and uploads to its own mesh. Slots
+are *durable until acknowledged* — a stage that dies mid-step relaunches
+from its checkpoint and replays, and every slot its peers already
+produced is still there to re-read, so recovery never recomputes a
+neighbor's work. The interface is deliberately narrow (put / get /
+claim / release_step / stats) so a faster wire — real DCN send/recv, or
+ICI once jax grows cross-mesh transfer — can replace this one without
+touching the schedule or the per-stage programs.
+
+Delivery discipline:
+
+- **Produce once.** ``put`` claims the slot's commit counter with an
+  atomic fetch-add; only the first claimant writes. A replaying stage
+  (same step re-run after a crash) re-puts the same slot, loses the
+  claim, sees the slot complete, and skips — so a slot's payload is
+  written exactly once even when the producer runs the step twice.
+  If the first claimant died *mid-write* (commit claimed, meta never
+  landed), the replayer detects the incomplete slot and finishes it:
+  replay is deterministic, so the bytes it writes are the bytes the
+  dead writer would have written.
+- **Claim-once consume.** ``claim`` is a per-generation fetch-add on
+  the slot's claim counter: within one generation a slot feeds exactly
+  one consumer op (the duplicate-delivery audit), while a relaunched
+  generation claims afresh — replay re-reads are legitimate, double
+  consumption inside a live schedule is a bug.
+- **TTL hygiene.** Claim markers carry a TTL so a dead generation's
+  claims cannot satisfy (or poison) a later one forever. Slot payloads
+  are TTL'd only if asked — durability until ``release_step`` is what
+  makes crash replay cheap.
+- ``release_step`` garbage-collects every slot of an edge up to a step
+  the whole pipeline has applied; the leader calls it once per step.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+SLOT_PREFIX = "mpmd/slot"
+CLAIM_PREFIX = "mpmd/claim"
+
+
+def pack_arrays(arrays) -> tuple[dict, bytes]:
+    """[arrays] -> (meta, payload). Raw little-endian bytes, no pickling:
+    the payload crosses trust and process boundaries, and bitwise replay
+    parity needs the exact bits, not a codec's idea of them."""
+    meta_arrays = []
+    parts = []
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        meta_arrays.append({"shape": list(a.shape), "dtype": a.dtype.str})
+        parts.append(a.tobytes())
+    return {"arrays": meta_arrays}, b"".join(parts)
+
+
+def unpack_arrays(meta: dict, payload: bytes) -> list[np.ndarray]:
+    out = []
+    off = 0
+    for spec in meta["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        n = int(np.prod(spec["shape"], dtype=np.int64)) * dt.itemsize
+        out.append(
+            np.frombuffer(payload[off:off + n], dt).reshape(spec["shape"]))
+        off += n
+    if off != len(payload):
+        raise ValueError(
+            f"payload is {len(payload)} bytes, meta describes {off}")
+    return out
+
+
+@dataclass
+class TransportStats:
+    """Wire accounting for the bench receipt. Latencies are whole-op wall
+    times (staging + chunk puts / blocking wait + reassembly)."""
+
+    puts: int = 0
+    gets: int = 0
+    bytes_out: int = 0
+    bytes_in: int = 0
+    put_seconds: float = 0.0
+    get_seconds: float = 0.0
+    get_wait_seconds: float = 0.0  # time blocked on a slot not yet produced
+
+    def snapshot(self) -> dict:
+        return {
+            "puts": self.puts, "gets": self.gets,
+            "bytes_out": self.bytes_out, "bytes_in": self.bytes_in,
+            "put_seconds": round(self.put_seconds, 6),
+            "get_seconds": round(self.get_seconds, 6),
+            "get_wait_seconds": round(self.get_wait_seconds, 6),
+        }
+
+
+class Transport:
+    """Interface contract; see the module docstring for the semantics."""
+
+    stats: TransportStats
+
+    def put(self, edge: str, step: int, mb: int, arrays) -> bool:
+        """Publish a slot. True if this call won the produce claim, False
+        when the slot was already complete (idempotent replay)."""
+        raise NotImplementedError
+
+    def get(self, edge: str, step: int, mb: int, *,
+            timeout: float = 60.0) -> list[np.ndarray]:
+        """Block until the slot exists; TimeoutError past ``timeout``."""
+        raise NotImplementedError
+
+    def poll(self, edge: str, step: int, mb: int) -> bool:
+        raise NotImplementedError
+
+    def claim(self, edge: str, step: int, mb: int, generation: int) -> bool:
+        """Claim-once consume marker; True exactly once per generation."""
+        raise NotImplementedError
+
+    def release_step(self, edge: str, step: int) -> None:
+        """Drop every slot of ``edge`` at ``step`` (pipeline has applied)."""
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """In-process transport over a dict + condition variable. Same
+    produce-once/claim-once contract as the KV wire (a slot survives its
+    producer; replays re-put idempotently), so the tier-1 schedule and
+    recovery tests exercise the exact delivery discipline the distributed
+    path relies on — without sockets."""
+
+    def __init__(self):
+        self._slots: dict[tuple, tuple[dict, bytes]] = {}
+        self._commits: dict[tuple, int] = {}
+        self._claims: dict[tuple, int] = {}
+        self._cond = threading.Condition()
+        self.stats = TransportStats()
+
+    def put(self, edge, step, mb, arrays) -> bool:
+        t0 = time.perf_counter()
+        meta, payload = pack_arrays(arrays)
+        key = (edge, step, mb)
+        with self._cond:
+            self._commits[key] = self._commits.get(key, 0) + 1
+            first = self._commits[key] == 1
+            if not first and key in self._slots:
+                return False
+            self._slots[key] = (meta, payload)
+            self._cond.notify_all()
+        self.stats.puts += 1
+        self.stats.bytes_out += len(payload)
+        self.stats.put_seconds += time.perf_counter() - t0
+        return first
+
+    def get(self, edge, step, mb, *, timeout: float = 60.0):
+        t0 = time.perf_counter()
+        key = (edge, step, mb)
+        deadline = t0 + timeout
+        with self._cond:
+            while key not in self._slots:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise TimeoutError(f"transport slot {key} never arrived")
+                self.stats.get_wait_seconds += min(remaining, 0.05)
+                self._cond.wait(min(remaining, 0.05))
+            meta, payload = self._slots[key]
+        out = unpack_arrays(meta, payload)
+        self.stats.gets += 1
+        self.stats.bytes_in += len(payload)
+        self.stats.get_seconds += time.perf_counter() - t0
+        return out
+
+    def poll(self, edge, step, mb) -> bool:
+        with self._cond:
+            return (edge, step, mb) in self._slots
+
+    def claim(self, edge, step, mb, generation) -> bool:
+        key = (edge, step, mb, generation)
+        with self._cond:
+            self._claims[key] = self._claims.get(key, 0) + 1
+            return self._claims[key] == 1
+
+    def release_step(self, edge, step) -> None:
+        with self._cond:
+            for key in [k for k in self._slots if k[0] == edge
+                        and k[1] == step]:
+                del self._slots[key]
+
+    # -- audit (tier-1 delivery tests) --------------------------------------
+
+    def audit(self) -> dict:
+        """Counters for the zero-dup/zero-loss audit: commit attempts per
+        slot and claims per (slot, generation)."""
+        with self._cond:
+            return {
+                "commits": {"/".join(map(str, k)): v
+                            for k, v in self._commits.items()},
+                "claims": {"/".join(map(str, k)): v
+                           for k, v in self._claims.items()},
+            }
+
+
+class KVTransport(Transport):
+    """The KV-store wire. Chunked puts sized under the client's 1 MiB
+    read cap; meta is written LAST so its presence is the slot-complete
+    signal; commit/claim counters give produce-once / claim-once.
+
+    ``kv`` may be namespaced or raw — stages of one pipeline must share
+    the SAME namespace view (the transport plane is cross-job state when
+    stages run as separate scheduler jobs, so it lives under a pipeline
+    prefix, not under either job's ``job/<id>/``).
+    """
+
+    def __init__(self, kv, *, prefix: str = "", chunk_bytes: int = 256 << 10,
+                 claim_ttl: float = 600.0, slot_ttl: float | None = None,
+                 poll_interval: float = 0.005):
+        if chunk_bytes < 1 or chunk_bytes > (1 << 20) - 4096:
+            raise ValueError(
+                f"chunk_bytes {chunk_bytes} must fit the KV read cap (1MiB)")
+        self.kv = kv
+        self.prefix = prefix.rstrip("/") + "/" if prefix else ""
+        self.chunk_bytes = chunk_bytes
+        self.claim_ttl = claim_ttl
+        self.slot_ttl = slot_ttl
+        self.poll_interval = poll_interval
+        self.stats = TransportStats()
+
+    def _slot(self, edge: str, step: int, mb: int) -> str:
+        return f"{self.prefix}{SLOT_PREFIX}/{edge}/{step}/{mb}"
+
+    def _set(self, key: str, val: bytes) -> None:
+        if self.slot_ttl is not None:
+            self.kv.set_ttl(key, val, self.slot_ttl)
+        else:
+            self.kv.set(key, val)
+
+    def put(self, edge, step, mb, arrays) -> bool:
+        t0 = time.perf_counter()
+        meta, payload = pack_arrays(arrays)
+        slot = self._slot(edge, step, mb)
+        first = self.kv.add(f"{slot}/commit", 1) == 1
+        if not first and self.kv.try_get(f"{slot}/meta") is not None:
+            return False  # complete slot: replay no-op
+        # not first but incomplete: the claimant died mid-write — finish
+        # its slot (deterministic replay writes the identical bytes)
+        nchunks = -(-len(payload) // self.chunk_bytes) if payload else 0
+        for i in range(nchunks):
+            self._set(f"{slot}/chunk/{i}",
+                      payload[i * self.chunk_bytes:(i + 1) * self.chunk_bytes])
+        meta = dict(meta, nchunks=nchunks, bytes=len(payload),
+                    seq=(step, mb))
+        self._set(f"{slot}/meta", json.dumps(meta).encode())
+        self.stats.puts += 1
+        self.stats.bytes_out += len(payload)
+        self.stats.put_seconds += time.perf_counter() - t0
+        return first
+
+    def get(self, edge, step, mb, *, timeout: float = 60.0):
+        t0 = time.perf_counter()
+        slot = self._slot(edge, step, mb)
+        deadline = t0 + timeout
+        raw = self.kv.try_get(f"{slot}/meta")
+        while raw is None:
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"transport slot {slot} never arrived ({timeout}s)")
+            time.sleep(self.poll_interval)
+            self.stats.get_wait_seconds += self.poll_interval
+            raw = self.kv.try_get(f"{slot}/meta")
+        meta = json.loads(raw)
+        parts = []
+        for i in range(meta["nchunks"]):
+            chunk = self.kv.try_get(f"{slot}/chunk/{i}")
+            if chunk is None:
+                raise RuntimeError(
+                    f"slot {slot} chunk {i} missing under a complete meta "
+                    "(released early, or TTL expired mid-read)")
+            parts.append(chunk)
+        payload = b"".join(parts)
+        if len(payload) != meta["bytes"]:
+            raise RuntimeError(
+                f"slot {slot}: reassembled {len(payload)} bytes, "
+                f"meta says {meta['bytes']}")
+        out = unpack_arrays(meta, payload)
+        self.stats.gets += 1
+        self.stats.bytes_in += len(payload)
+        self.stats.get_seconds += time.perf_counter() - t0
+        return out
+
+    def poll(self, edge, step, mb) -> bool:
+        return self.kv.try_get(f"{self._slot(edge, step, mb)}/meta") is not None
+
+    def claim(self, edge, step, mb, generation) -> bool:
+        key = (f"{self.prefix}{CLAIM_PREFIX}/{generation}/{edge}/{step}/{mb}")
+        n = self.kv.add(key, 1)
+        if n == 1:
+            # fetch-add created a plain counter; re-arm it as TTL'd so a
+            # dead generation's claims expire (value no longer needs to
+            # count past "claimed at least twice" for the audit)
+            self.kv.set_ttl(key, str(n), self.claim_ttl)
+        return n == 1
+
+    def release_step(self, edge, step) -> None:
+        self.kv.delete_prefix(f"{self.prefix}{SLOT_PREFIX}/{edge}/{step}/")
+
+    # -- audit --------------------------------------------------------------
+
+    def audit(self) -> dict:
+        """Commit counters per live slot and claim counters per generation
+        (released slots drop out of ``commits``; claims persist until
+        their TTL, which is what the post-mortem audit reads)."""
+        commits, claims = {}, {}
+        for key in self.kv.keys(f"{self.prefix}{SLOT_PREFIX}/"):
+            if key.endswith("/commit"):
+                commits[key[len(self.prefix) + len(SLOT_PREFIX) + 1:
+                            -len("/commit")]] = int(self.kv.get(key))
+        for key in self.kv.keys(f"{self.prefix}{CLAIM_PREFIX}/"):
+            raw = self.kv.try_get(key)
+            if raw is not None:
+                claims[key[len(self.prefix) + len(CLAIM_PREFIX) + 1:]] = (
+                    int(raw))
+        return {"commits": commits, "claims": claims}
+
+
+@dataclass
+class EdgeNames:
+    """The two directed edges between adjacent stages s and s+1."""
+
+    stage: int
+    act: str = field(init=False)   # activations s -> s+1
+    grad: str = field(init=False)  # cotangents  s+1 -> s
+
+    def __post_init__(self):
+        self.act = f"act{self.stage}"
+        self.grad = f"grad{self.stage}"

@@ -73,6 +73,7 @@ from tpu_sandbox.runtime.watchdog import Watchdog
 
 K_SEQ = "sched/seq"
 JOBS_PREFIX = "sched/jobs/"
+K_VTIME_PREFIX = "sched/vtime/"
 
 #: states a job can be observed in; terminal ones never change again
 QUEUED, RUNNING, PREEMPTING = "queued", "running", "preempting"
@@ -135,6 +136,13 @@ class JobSpec:
     # pool. Untenanted jobs keep plain FIFO-by-seq semantics.
     tenant: str = ""
     share: float = 1.0
+    # MPMD co-gangs: jobs naming the same cogroup are admitted
+    # all-or-nothing as one "gang of gangs" — a cross-mesh pipeline's
+    # per-stage HostAgent groups are useless admitted piecemeal (stage 1
+    # without stage 0 just blocks on the transport until it times out).
+    # Preemption makes room for the whole group's host total, and
+    # backfill never slips one member of the head's own group in early.
+    cogroup: str = ""
 
     def __post_init__(self):
         if not job_namespace(self.job_id):
@@ -212,6 +220,7 @@ def list_jobs(kv: KVClient) -> list[dict]:
             "world_size": spec.world_size,
             "tenant": spec.tenant,
             "share": spec.share,
+            "cogroup": spec.cogroup,
         })
     return sorted(out, key=lambda j: j["seq"])
 
@@ -332,10 +341,11 @@ class ClusterScheduler:
         self._server: KVServer | None = None
         self._running: dict[str, _RunningJob] = {}
         self._queue_deadline: dict[str, float] = {}
-        # tenant -> accumulated normalized service (host-seconds / share);
-        # scheduler-lifetime state, deliberately not durable: fair share is
-        # a steady-state property, a successor restarting from zero only
-        # forgets old debts
+        # tenant -> accumulated normalized service (host-seconds / share),
+        # mirrored to the store under sched/vtime/<tenant> on every charge
+        # tick: a successor scheduler loads the ledger in start() and
+        # keeps converging to the same weighted shares instead of
+        # forgetting every tenant's accumulated debt at each failover
         self._tenant_vtime: dict[str, float] = {}
         self._last_charge = time.monotonic()
         self._stop = False
@@ -353,8 +363,21 @@ class ClusterScheduler:
             else:
                 self._server = self._kv_server or KVServer()
                 self.kv = KVClient(port=self._server.port)
+            self._load_vtime()
             self._adopt_orphans()
         return self
+
+    def _load_vtime(self) -> None:
+        """Restore the durable fair-share ledger a predecessor left in
+        the store (sched/vtime/<tenant>)."""
+        for key in self.kv.keys(K_VTIME_PREFIX):
+            raw = self.kv.try_get(key)
+            if raw is None:
+                continue
+            try:
+                self._tenant_vtime[key[len(K_VTIME_PREFIX):]] = float(raw)
+            except ValueError:
+                continue
 
     def close(self) -> None:
         for job in self._running.values():
@@ -619,9 +642,12 @@ class ClusterScheduler:
         for job in self._running.values():
             tenant = job.spec.tenant
             if tenant:
-                self._tenant_vtime[tenant] = (
-                    self._tenant_vtime.get(tenant, 0.0)
-                    + job.spec.hosts * dt / job.spec.share)
+                vt = (self._tenant_vtime.get(tenant, 0.0)
+                      + job.spec.hosts * dt / job.spec.share)
+                self._tenant_vtime[tenant] = vt
+                # durable ledger: a successor scheduler resumes the
+                # 2:1 convergence instead of resetting every debt
+                self.kv.set(f"{K_VTIME_PREFIX}{tenant}", repr(vt))
 
     def tenant_vtime(self, tenant: str) -> float:
         return self._tenant_vtime.get(tenant, 0.0)
@@ -662,16 +688,20 @@ class ClusterScheduler:
         if raw is None:
             return
         spec = JobSpec.from_json(raw.decode())
+        group = self._cogroup_members(order, head, spec)
+        needed = sum(s.hosts for s, _ in group)
         free = self._slots_free()
-        if spec.hosts <= free:
-            self._admit(spec, head["seq"])
+        if needed <= free:
+            for member, seq in group:
+                self._admit(member, seq)
             return
         # not enough room: can lower-priority running work make room?
-        victims = self._pick_victims(spec, free)
+        victims = self._pick_victims(spec, free, needed=needed)
         if victims:
-            self._queue_deadline[spec.job_id] = (
-                time.monotonic() + spec.admission_timeout
-            )  # give the head a fresh window while its room is made
+            for member, _ in group:
+                self._queue_deadline[member.job_id] = (
+                    time.monotonic() + member.admission_timeout
+                )  # give the group a fresh window while its room is made
             for victim in victims:
                 victim.preempting = True
                 self.kv.set(k_state(victim.spec.job_id), PREEMPTING)
@@ -684,10 +714,28 @@ class ClusterScheduler:
                 )
                 self._terminate_gang(victim)
             return
-        self._try_backfill(order, spec, free)
+        self._try_backfill(order, spec, free, needed=needed)
+
+    def _cogroup_members(self, order: list[dict], head: dict,
+                         head_spec: JobSpec) -> list[tuple[JobSpec, int]]:
+        """The head plus every other queued member of its cogroup, as
+        ``(spec, seq)`` pairs — an MPMD pipeline's stage gangs admit
+        all-or-nothing, one gang of gangs. A solo head is its own
+        singleton group."""
+        group = [(head_spec, head["seq"])]
+        if not head_spec.cogroup:
+            return group
+        for entry in order[1:]:
+            if entry.get("cogroup") != head_spec.cogroup:
+                continue
+            raw = self.kv.try_get(k_spec(entry["job_id"]))
+            if raw is None:
+                continue
+            group.append((JobSpec.from_json(raw.decode()), entry["seq"]))
+        return group
 
     def _try_backfill(self, order: list[dict], head_spec: JobSpec,
-                      free: int) -> None:
+                      free: int, needed: int | None = None) -> None:
         """The head is blocked and no preemption can help it. Strictly
         lower-priority queued jobs that fit the free slots may start
         behind it: strictly lower keeps the head's preemption rights over
@@ -696,13 +744,15 @@ class ClusterScheduler:
         stops backfilling once the head has consumed
         ``backfill_guard_frac`` of its admission window, reserving the
         rest of the window for room to appear rather than churn."""
+        if needed is None:
+            needed = head_spec.hosts
         if free < 1 or len(order) < 2:
             return
         pending = sum(
             j.spec.hosts for j in self._running.values()
             if j.preempting or j.cancelling
         )
-        if free + pending >= head_spec.hosts:
+        if free + pending >= needed:
             return  # the head's room is already on its way: don't take it
         dl = self._queue_deadline.get(head_spec.job_id)
         if dl is not None and dl - time.monotonic() <= (
@@ -718,6 +768,8 @@ class ClusterScheduler:
             if raw is None:
                 continue
             cand = JobSpec.from_json(raw.decode())
+            if head_spec.cogroup and cand.cogroup == head_spec.cogroup:
+                continue  # the head's own co-gang never backfills itself
             if cand.hosts > free:
                 continue
             self.kv.set(k_event(cand.job_id, "backfilled"),
@@ -730,17 +782,21 @@ class ClusterScheduler:
             self._admit(cand, entry["seq"])
             free = self._slots_free()
 
-    def _pick_victims(self, spec: JobSpec, free: int) -> list[_RunningJob]:
+    def _pick_victims(self, spec: JobSpec, free: int,
+                      needed: int | None = None) -> list[_RunningJob]:
         """Lowest priority first, newest first within a priority; only
         strictly-lower-priority jobs are preemptable, and only if the
-        freed slots actually satisfy the head job (never preempt for
-        nothing). Jobs already winding down are counted as pending room
-        rather than re-victimized."""
+        freed slots actually satisfy ``needed`` hosts — the head job
+        alone, or its whole cogroup (never preempt for nothing). Jobs
+        already winding down are counted as pending room rather than
+        re-victimized."""
+        if needed is None:
+            needed = spec.hosts
         pending = sum(
             j.spec.hosts for j in self._running.values()
             if j.preempting or j.cancelling
         )
-        if free + pending >= spec.hosts:
+        if free + pending >= needed:
             return []  # enough room is already on its way
         candidates = sorted(
             (j for j in self._running.values()
@@ -751,11 +807,11 @@ class ClusterScheduler:
         chosen: list[_RunningJob] = []
         room = free + pending
         for j in candidates:
-            if room >= spec.hosts:
+            if room >= needed:
                 break
             chosen.append(j)
             room += j.spec.hosts
-        return chosen if room >= spec.hosts else []
+        return chosen if room >= needed else []
 
     def _spawn_agent(self, spec: JobSpec, aid: int) -> subprocess.Popen:
         env = dict(os.environ)
