@@ -79,11 +79,19 @@ def _no_resource_leaks():
     requests after a test means the test abandoned in-flight work (the
     replica drain/requeue paths exist precisely so nothing is ever
     abandoned), so it fails the same way a leaked server does.
+
+    Gateways count the same way a KVServer does: a live one holds its
+    listening port and a cloned KV connection for the rest of the session.
     """
     from tpu_sandbox.runtime import kvstore
 
     threads_before = set(threading.enumerate())
     servers_before = set(kvstore.live_servers())
+    gateways_before = set()
+    if "tpu_sandbox.gateway.server" in sys.modules:
+        from tpu_sandbox.gateway.server import live_gateways
+
+        gateways_before = set(live_gateways())
     yield
     me = threading.current_thread()
 
@@ -113,6 +121,19 @@ def _no_resource_leaks():
             problems.append(
                 f"{len(busy)} serve engine(s) abandoned with in-flight "
                 f"work (active, waiting): {loads}"
+            )
+    if "tpu_sandbox.gateway.server" in sys.modules:
+        from tpu_sandbox.gateway.server import live_gateways
+
+        open_gateways = [g for g in live_gateways()
+                         if g not in gateways_before]
+        if open_gateways:
+            gw_ports = [g.port for g in open_gateways]
+            for g in open_gateways:  # free ports/threads for the session
+                g.close()
+            problems.append(
+                f"{len(gw_ports)} gateway(s) left running on port(s) "
+                f"{gw_ports}"
             )
     if leaked_servers:
         ports = [s.port for s in leaked_servers]

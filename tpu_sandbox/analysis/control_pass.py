@@ -1,4 +1,5 @@
-"""Pass 3: control-plane lint over ``runtime/`` and ``serve/`` (AST).
+"""Pass 3: control-plane lint over ``runtime/``, ``serve/`` and
+``gateway/`` (AST).
 
 Six rules distilled from this repo's own elastic-runtime and serving
 incident history:
@@ -622,11 +623,11 @@ def lint_source(source: str, path: str) -> list[Finding]:
 def run_control_pass(
     root: str, *, paths: list[str] | None = None,
 ) -> list[Finding]:
-    """Lint ``runtime/`` + ``serve/`` (or explicit ``paths``); labels are
-    root-relative."""
+    """Lint ``runtime/`` + ``serve/`` + ``gateway/`` (or explicit
+    ``paths``); labels are root-relative."""
     if paths is None:
         paths = []
-        for pkg in ("runtime", "serve"):
+        for pkg in ("runtime", "serve", "gateway"):
             pkg_dir = os.path.join(root, "tpu_sandbox", pkg)
             if os.path.isdir(pkg_dir):
                 for fn in sorted(os.listdir(pkg_dir)):

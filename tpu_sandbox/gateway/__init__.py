@@ -1,0 +1,39 @@
+"""Network serving gateway: the fleet's front door.
+
+External clients connect here over TCP (``wire`` framing, shared-secret
+hello) instead of holding KV-store credentials; the gateway routes each
+request to the replica with the deepest resident prefix match
+(``routing``), refuses requests that provably cannot make their deadline
+(SLO-feasibility admission), and serves several model fleets from one
+endpoint (``fleet`` namespacing). See gateway/server.py for the full
+design narrative.
+"""
+
+from tpu_sandbox.gateway.client import (GatewayAuthError, GatewayClient,
+                                        GatewayError)
+from tpu_sandbox.gateway.fleet import (DEFAULT_FLEET, FleetSpec,
+                                       fleet_kv, fleet_namespace)
+from tpu_sandbox.gateway.routing import (ReplicaView, admit, choose,
+                                         feasible, fresh, match_depth,
+                                         parse_report)
+from tpu_sandbox.gateway.server import Gateway, GatewayStats, live_gateways
+
+__all__ = [
+    "DEFAULT_FLEET",
+    "FleetSpec",
+    "Gateway",
+    "GatewayAuthError",
+    "GatewayClient",
+    "GatewayError",
+    "GatewayStats",
+    "ReplicaView",
+    "admit",
+    "choose",
+    "feasible",
+    "fleet_kv",
+    "fleet_namespace",
+    "fresh",
+    "live_gateways",
+    "match_depth",
+    "parse_report",
+]

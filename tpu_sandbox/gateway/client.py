@@ -1,0 +1,183 @@
+"""Socket-path serve client: ``ServeClient``'s API over the gateway wire.
+
+``GatewayClient`` presents the same submit / result / retry / hedge
+surface as ``serve/client.py::ServeClient`` but talks to a ``Gateway``
+over TCP instead of to the KV store directly — the shape a real external
+caller has, with no store credentials and no knowledge of the serve key
+schema. Differences that exist because the door does:
+
+- ``submit`` returns **False when the gateway sheds at the door**
+  (infeasible deadline / full fleet). The verdict slot still holds an
+  explicit SHED body, so ``result`` on a refused rid returns that verdict
+  (or retries it, same as any other shed) rather than hanging.
+- verdict waits are **server-side**: one 'W' frame parks on the gateway
+  until the verdict lands or the bounded wait expires, instead of the
+  client polling the store — clients pace retries/hedges between waits.
+- retry and hedge go through the gateway ('C' clear + fresh 'S';
+  'E' hedge), which re-routes with current fleet state — the retry of a
+  shed request may land on a different replica than the original.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass
+
+from tpu_sandbox.gateway import wire
+from tpu_sandbox.serve.client import ClientStats
+
+
+@dataclass
+class _Pending:
+    prompt: list[int]
+    max_new_tokens: int
+    deadline_s: float | None
+    temperature: float
+    top_k: int
+    seed: int
+    submitted_at: float = 0.0
+    retries_left: int = 0
+    hedged: bool = False
+
+
+class GatewayError(Exception):
+    """The gateway answered ST_ERR — a request-level failure."""
+
+
+class GatewayAuthError(GatewayError):
+    """Hello refused: wrong or missing shared secret."""
+
+
+class GatewayClient:
+    """One caller's connection to the gateway. Not thread-safe; make one
+    per caller thread (they share the gateway, not this socket)."""
+
+    def __init__(self, port: int, *, host: str = "127.0.0.1",
+                 token: str | None = None, fleet: str = "",
+                 deadline_s: float | None = None, max_retries: int = 2,
+                 hedge_after: float | None = None,
+                 connect_timeout: float = 5.0):
+        self.fleet = fleet
+        self.deadline_s = deadline_s
+        self.max_retries = max_retries
+        self.hedge_after = hedge_after
+        self.stats = ClientStats()
+        self._pending: dict[str, _Pending] = {}
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        if token is not None:
+            status, body = self._call(wire.OP_HELLO, {"token": token})
+            if status != wire.ST_OK:
+                self.close()
+                raise GatewayAuthError(body.get("error", "hello refused"))
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self) -> "GatewayClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _call(self, op: int, body: dict) -> tuple[int, dict]:
+        wire.send_frame(self._sock, op, dict(body, fleet=self.fleet))
+        return wire.recv_response(self._sock)
+
+    def _checked(self, op: int, body: dict) -> tuple[int, dict]:
+        status, resp = self._call(op, body)
+        if status == wire.ST_ERR:
+            raise GatewayError(resp.get("error", "gateway error"))
+        if status == wire.ST_AUTH:
+            raise GatewayAuthError(resp.get("error", "auth required"))
+        return status, resp
+
+    # -- the ServeClient surface ---------------------------------------------
+
+    def submit(self, rid: str, prompt, max_new_tokens: int, *,
+               deadline_s: float | None = None, temperature: float = 0.0,
+               top_k: int = 0, seed: int = 0) -> bool:
+        """Route one request through the door. True when admitted; False
+        when the gateway shed it there (its SHED verdict is already in
+        place, and ``result`` will burn a retry on it like any shed)."""
+        d = self.deadline_s if deadline_s is None else deadline_s
+        p = _Pending(prompt=[int(t) for t in prompt],
+                     max_new_tokens=int(max_new_tokens), deadline_s=d,
+                     temperature=temperature, top_k=top_k, seed=seed,
+                     submitted_at=time.time(),
+                     retries_left=self.max_retries)
+        self._pending[rid] = p
+        self.stats.submitted += 1
+        return self._submit_body(rid, p)
+
+    def _submit_body(self, rid: str, p: _Pending) -> bool:
+        body = {"rid": rid, "prompt": p.prompt,
+                "max_new_tokens": p.max_new_tokens}
+        if p.deadline_s is not None:
+            body["deadline_s"] = p.deadline_s
+        if p.temperature > 0.0:
+            body.update(temperature=p.temperature, top_k=p.top_k,
+                        seed=p.seed)
+        _status, resp = self._checked(wire.OP_SUBMIT, body)
+        return bool(resp.get("admitted"))
+
+    def result(self, rid: str, timeout: float = 60.0) -> dict:
+        """Block until ``rid`` has a terminal verdict, retrying sheds and
+        hedging stragglers. Same contract as ``ServeClient.result``."""
+        p = self._pending.get(rid)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"no verdict for {rid} within {timeout}s")
+            # bounded server-side wait; short slices so hedge checks run
+            slice_s = min(remaining,
+                          0.25 if self.hedge_after is not None else 5.0)
+            status, verdict = self._checked(
+                wire.OP_WAIT, {"rid": rid, "timeout": slice_s})
+            if status != wire.ST_OK:
+                if p is not None:
+                    self._maybe_hedge(rid, p)
+                continue
+            if verdict.get("verdict", "ok") != "SHED":
+                self._pending.pop(rid, None)
+                self.stats.completed += 1
+                return verdict
+            if p is None or p.retries_left <= 0:
+                self._pending.pop(rid, None)
+                self.stats.shed += 1
+                return verdict
+            self._retry(rid, p)
+
+    def _retry(self, rid: str, p: _Pending) -> None:
+        p.retries_left -= 1
+        p.submitted_at = time.time()
+        p.hedged = False
+        self._checked(wire.OP_CLEAR, {"rid": rid})
+        self._submit_body(rid, p)  # fresh deadline, fresh routing
+        self.stats.retries += 1
+
+    def _maybe_hedge(self, rid: str, p: _Pending) -> None:
+        if p.hedged or self.hedge_after is None:
+            return
+        if time.time() - p.submitted_at < self.hedge_after:
+            return
+        status, resp = self._checked(wire.OP_HEDGE, {"rid": rid})
+        # "already has a verdict/lease" answers are not hedges; only an
+        # actual duplicate enqueue consumes this request's hedge
+        if status == wire.ST_OK and resp.get("hedged"):
+            p.hedged = True
+            self.stats.hedges += 1
+
+    # -- extras ---------------------------------------------------------------
+
+    def try_result(self, rid: str) -> dict | None:
+        status, verdict = self._checked(wire.OP_TRY, {"rid": rid})
+        return verdict if status == wire.ST_OK else None
+
+    def gateway_stats(self) -> dict:
+        _status, body = self._checked(wire.OP_STATS, {})
+        return body

@@ -1,0 +1,537 @@
+"""The gateway process: one socket front door for the replica fleet.
+
+``Gateway`` terminates client TCP connections (wire protocol in
+``gateway/wire.py``), decides routing and admission per request, and
+talks to the serve plane through the same KV keys replicas use — it is a
+*client* of the serve protocol, not a new authority. Every correctness
+property (claim-once verdicts, lease scavenging, drain/requeue) is
+enforced by that protocol underneath; the gateway only decides *where*
+work lands and *whether* it is worth landing at all.
+
+Per admitted request:
+
+1. hash the prompt's full blocks (``serve/cache.chain_digest``) with the
+   fleet's block size;
+2. match against the replica digests cached from ``serve/load/<tag>``
+   reports; route to the deepest resident-prefix match via that replica's
+   targeted queue (``serve/tq/<tag>/``), falling back to least-loaded,
+   falling back to the shared queue when no report is fresh;
+3. before enqueueing, run the admission policy (SLO feasibility by
+   default). A door shed claims ``serve/done/<rid>`` and writes an
+   explicit SHED verdict — the audit invariant "every rid gets exactly
+   one terminal verdict" holds no matter where the shed happens.
+
+Load reports are cached with *local* staleness: the gateway stamps
+``time.monotonic()`` when a report's bytes change and ages against that
+stamp — never wall-clock arithmetic against the replica's own clock
+(cross-host skew; GL-R302). A report the KV TTL already expired drops
+out of the table entirely on the next refresh.
+
+The server is a plain asyncio loop on a daemon thread: the KV round
+trips it performs per request are sub-millisecond against the local
+store, so handlers call them inline; only verdict *waits* yield the loop
+(``asyncio.sleep`` polling), keeping every other connection live while
+one blocks on a slow decode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import json
+import os
+import random
+import signal
+import socket
+import sys
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+
+from tpu_sandbox.gateway import wire
+from tpu_sandbox.gateway import routing
+from tpu_sandbox.gateway.fleet import DEFAULT_FLEET, FleetSpec, fleet_kv
+from tpu_sandbox.runtime.kvstore import KVClient
+from tpu_sandbox.runtime.supervisor import ENV_KV_PORT
+from tpu_sandbox.serve.cache import chain_digest
+from tpu_sandbox.serve.replica import (enqueue, enqueue_to, k_done, k_lease,
+                                       k_req, k_result, write_request)
+
+#: rid -> routed-replica memory per fleet, for hedge target exclusion; a
+#: bounded ring — forgetting an old route only costs hedge precision
+ROUTE_MEMORY = 4096
+
+_LIVE_GATEWAYS: "weakref.WeakSet[Gateway]" = weakref.WeakSet()
+
+
+def live_gateways() -> list["Gateway"]:
+    """Gateways constructed but not yet closed — the conftest leak check."""
+    return [g for g in _LIVE_GATEWAYS if not g.closed]
+
+
+@dataclass
+class GatewayStats:
+    connections: int = 0
+    requests: int = 0
+    admitted: int = 0
+    shed_door: int = 0
+    routed_prefix: int = 0      # targeted, with a resident-prefix match
+    routed_balance: int = 0     # targeted, least-loaded fallback
+    routed_shared: int = 0      # no fresh report anywhere: shared queue
+    hedges: int = 0
+    clears: int = 0
+    auth_failures: int = 0
+    protocol_errors: int = 0
+
+
+@dataclass
+class _ReplicaEntry:
+    """One replica's last-seen load report plus the local change stamp."""
+
+    raw: bytes
+    report: dict
+    changed_at: float  # time.monotonic() when ``raw`` last changed
+
+
+@dataclass
+class _FleetState:
+    spec: FleetSpec
+    kv: object  # fleet-scoped KV view, used only on the gateway thread
+    replicas: dict = field(default_factory=dict)   # tag -> _ReplicaEntry
+    inflight: dict = field(default_factory=dict)   # tag -> routed-unreported
+    routes: dict = field(default_factory=dict)     # rid -> tag (bounded)
+    last_refresh: float = -1e9
+
+    def note_route(self, rid: str, tag: str) -> None:
+        self.routes.pop(rid, None)
+        self.routes[rid] = tag
+        while len(self.routes) > ROUTE_MEMORY:
+            self.routes.pop(next(iter(self.routes)))
+
+
+class Gateway:
+    """Accepts client connections, routes requests across the fleet(s).
+
+    One instance owns one listening socket, one KV connection (a clone of
+    the one passed in — the gateway thread must not share a socket with
+    the caller), and one routing table per fleet. ``start()`` returns
+    once the port is bound; ``close()`` is idempotent and joins the
+    thread."""
+
+    def __init__(self, kv: KVClient, fleets: list[FleetSpec] | None = None,
+                 *, host: str = "127.0.0.1", port: int = 0,
+                 token: str | None = None, admission: str = "feasible",
+                 policy: str = "prefix", policy_seed: int = 0,
+                 max_report_age_s: float = 5.0,
+                 refresh_min_s: float = 0.02, wait_cap_s: float = 60.0):
+        specs = fleets or [FleetSpec(name=DEFAULT_FLEET)]
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate fleet names: {names}")
+        if admission not in ("feasible", "occupancy", "none"):
+            raise ValueError(f"unknown admission mode {admission!r}")
+        if policy not in ("prefix", "random"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self._kv = kv.clone()
+        self._fleets = {
+            s.name: _FleetState(spec=s, kv=fleet_kv(self._kv, s.name))
+            for s in specs
+        }
+        self._host = host
+        self._requested_port = port
+        self._token = token
+        self.admission = admission
+        # 'prefix' is the product; 'random' is the control arm the bench
+        # measures the TTFT win against (uniform over fresh views)
+        self.policy = policy
+        self._rng = random.Random(policy_seed)
+        self.max_report_age_s = max_report_age_s
+        self.refresh_min_s = refresh_min_s
+        self.wait_cap_s = wait_cap_s
+        self.stats = GatewayStats()
+        self.port: int | None = None
+        self.closed = False
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._stop: asyncio.Event | None = None
+        self._started = threading.Event()
+        self._startup_error: BaseException | None = None
+        _LIVE_GATEWAYS.add(self)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        self._thread = threading.Thread(
+            target=self._thread_main, name="gateway", daemon=True)
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("gateway did not start within 10s")
+        if self._startup_error is not None:
+            raise RuntimeError("gateway failed to start") \
+                from self._startup_error
+        return self
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self._loop is not None and self._thread is not None \
+                and self._thread.is_alive() and self._stop is not None:
+            with contextlib.suppress(RuntimeError):  # loop already gone
+                self._loop.call_soon_threadsafe(self._stop.set)
+            self._thread.join(timeout=5.0)
+        self._kv.close()
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _thread_main(self) -> None:
+        try:
+            asyncio.run(self._amain())
+        except BaseException as e:  # surface bind errors to start()
+            self._startup_error = e
+        finally:
+            self._started.set()
+
+    async def _amain(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        server = await asyncio.start_server(
+            self._handle, self._host, self._requested_port)
+        self.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with server:
+            await self._stop.wait()
+        # asyncio.run's shutdown cancels any still-open connection handlers
+
+    # -- connection handling -------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.stats.connections += 1
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        authed = self._token is None
+        try:
+            while True:
+                op, payload = await wire.read_frame(reader)
+                if op == wire.OP_HELLO:
+                    authed = await self._hello(writer, payload)
+                    if not authed:
+                        return
+                    continue
+                if not authed:
+                    # any op before a good hello is an auth failure, even a
+                    # well-formed one — close, never serve
+                    self.stats.auth_failures += 1
+                    await wire.write_response(
+                        writer, wire.ST_AUTH, {"error": "hello required"})
+                    return
+                if op not in wire.KNOWN_OPS:
+                    raise wire.ProtocolError(f"unknown op {op}")
+                status, resp = await self._dispatch(op,
+                                                   wire.decode_body(payload))
+                await wire.write_response(writer, status, resp)
+        except asyncio.IncompleteReadError as e:
+            # bare EOF between frames is a clean disconnect; EOF mid-frame
+            # is a protocol violation (truncated frame)
+            if e.partial:
+                self.stats.protocol_errors += 1
+        except wire.ProtocolError:
+            self.stats.protocol_errors += 1
+        except (ConnectionError, OSError):
+            pass  # peer vanished; nothing to answer
+        finally:
+            # a request exists only once its 'S' frame fully dispatched, so
+            # closing here never strands one — it just ends the conversation
+            writer.close()
+            with contextlib.suppress(ConnectionError, OSError):
+                await writer.wait_closed()
+
+    async def _hello(self, writer: asyncio.StreamWriter,
+                     payload: bytes) -> bool:
+        body = wire.decode_body(payload) if payload else {}
+        if self._token is None or body.get("token") == self._token:
+            await wire.write_response(writer, wire.ST_OK, {})
+            return True
+        self.stats.auth_failures += 1
+        await wire.write_response(
+            writer, wire.ST_AUTH, {"error": "bad token"})
+        return False
+
+    async def _dispatch(self, op: int, body: dict) -> tuple[int, dict]:
+        if op == wire.OP_STATS:
+            return wire.ST_OK, self._stats_body()
+        try:
+            fleet = self._fleet_of(body)
+        except KeyError as e:
+            return wire.ST_ERR, {"error": str(e)}
+        try:
+            if op == wire.OP_SUBMIT:
+                return self._submit(fleet, body)
+            if op == wire.OP_WAIT:
+                return await self._wait(fleet, body)
+            if op == wire.OP_TRY:
+                return self._try(fleet, body)
+            if op == wire.OP_HEDGE:
+                return self._hedge(fleet, body)
+            return self._clear(fleet, body)
+        except (KeyError, TypeError, ValueError) as e:
+            # a malformed *body* (missing rid, bad types) fails the one
+            # request, not the connection — the framing was fine
+            return wire.ST_ERR, {"error": f"{type(e).__name__}: {e}"}
+
+    def _fleet_of(self, body: dict) -> _FleetState:
+        name = body.get("fleet", DEFAULT_FLEET)
+        state = self._fleets.get(name)
+        if state is None:
+            raise KeyError(f"unknown fleet {name!r} "
+                           f"(serving: {sorted(self._fleets)})")
+        return state
+
+    # -- routing table -------------------------------------------------------
+
+    def _refresh(self, fleet: _FleetState) -> None:
+        """Re-read ``serve/load/`` if the cache is older than the refresh
+        floor. A report whose bytes changed gets a new local change stamp
+        and resets the routed-but-unreported count (the replica has since
+        told us what it actually sees); a report the TTL expired drops its
+        replica from the table."""
+        if time.monotonic() - fleet.last_refresh < self.refresh_min_s:
+            return
+        fleet.last_refresh = time.monotonic()
+        seen = set()
+        for key in fleet.kv.keys("serve/load/"):
+            raw = fleet.kv.try_get(key)
+            if raw is None:
+                continue  # expired between list and read
+            tag = key[len("serve/load/"):]
+            seen.add(tag)
+            entry = fleet.replicas.get(tag)
+            if entry is None or entry.raw != raw:
+                fleet.replicas[tag] = _ReplicaEntry(
+                    raw=raw, report=json.loads(raw),
+                    changed_at=time.monotonic())
+                fleet.inflight[tag] = 0
+        for tag in [t for t in fleet.replicas if t not in seen]:
+            del fleet.replicas[tag]
+            fleet.inflight.pop(tag, None)
+
+    def _views(self, fleet: _FleetState) -> list[routing.ReplicaView]:
+        now = time.monotonic()
+        return [
+            routing.parse_report(
+                tag, entry.report, age_s=now - entry.changed_at,
+                pending_local=fleet.inflight.get(tag, 0))
+            for tag, entry in sorted(fleet.replicas.items())
+        ]
+
+    # -- ops -----------------------------------------------------------------
+
+    def _submit(self, fleet: _FleetState, body: dict) -> tuple[int, dict]:
+        self.stats.requests += 1
+        rid = body["rid"]
+        prompt = [int(t) for t in body["prompt"]]
+        max_new = int(body["max_new_tokens"])
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+        self._refresh(fleet)
+        chain = chain_digest(prompt, fleet.spec.block_size)
+        views = routing.fresh(self._views(fleet), self.max_report_age_s)
+        if self.policy == "random" and views:
+            v = views[self._rng.randrange(len(views))]
+            choice = (v, routing.match_depth(chain, v))
+        else:
+            choice = routing.choose(chain, views)
+        if choice is None:
+            # nobody has reported yet (fleet warming up): nothing to
+            # estimate against, so admit to the shared queue — engine-side
+            # guardrails still apply once a replica claims it
+            self._enqueue_request(fleet, body, rid, prompt, max_new,
+                                  deadline_s, target=None)
+            self.stats.routed_shared += 1
+            self.stats.admitted += 1
+            return wire.ST_OK, {"admitted": True, "replica": "",
+                                "depth": 0, "routed": "shared"}
+        view, depth = choice
+        ok, reason, est = routing.admit(
+            view, mode=self.admission,
+            service_rate_rps=fleet.spec.service_rate_rps,
+            deadline_s=deadline_s,
+            occupancy_bound=fleet.spec.occupancy_bound)
+        if not ok:
+            self._door_shed(fleet, rid, reason, est)
+            return wire.ST_OK, {"admitted": False, "reason": reason,
+                                "estimate_s": round(est, 6),
+                                "replica": view.tag}
+        self._enqueue_request(fleet, body, rid, prompt, max_new,
+                              deadline_s, target=view.tag)
+        if depth > 0:
+            self.stats.routed_prefix += 1
+        else:
+            self.stats.routed_balance += 1
+        self.stats.admitted += 1
+        return wire.ST_OK, {"admitted": True, "replica": view.tag,
+                            "depth": depth, "estimate_s": round(est, 6),
+                            "routed": "prefix" if depth else "balance"}
+
+    def _enqueue_request(self, fleet: _FleetState, body: dict, rid: str,
+                         prompt: list[int], max_new: int,
+                         deadline_s: float | None,
+                         target: str | None) -> None:
+        write_request(
+            fleet.kv, rid, prompt, max_new,
+            deadline_unix=None if deadline_s is None
+            else time.time() + deadline_s,
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            seed=int(body.get("seed", 0)))
+        if target is None:
+            enqueue(fleet.kv, rid)
+        else:
+            enqueue_to(fleet.kv, target, rid)
+            fleet.inflight[target] = fleet.inflight.get(target, 0) + 1
+            fleet.note_route(rid, target)
+
+    def _door_shed(self, fleet: _FleetState, rid: str, reason: str,
+                   est: float) -> None:
+        """Refuse at the door with the same claim-once verdict discipline
+        replicas use: first publisher of serve/done/<rid> wins, so a
+        door shed racing a retry's fresh execution still yields exactly
+        one terminal verdict per rid."""
+        self.stats.shed_door += 1
+        if fleet.kv.add(k_done(rid)) == 1:
+            fleet.kv.set(k_result(rid), json.dumps({
+                "rid": rid, "verdict": "SHED", "reason": f"door:{reason}",
+                "estimate_s": round(est, 6), "replica": "gateway"}))
+
+    async def _wait(self, fleet: _FleetState,
+                    body: dict) -> tuple[int, dict]:
+        rid = body["rid"]
+        timeout = min(float(body.get("timeout", 30.0)), self.wait_cap_s)
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = fleet.kv.try_get(k_result(rid))
+            if raw is not None:
+                return wire.ST_OK, json.loads(raw)
+            if time.monotonic() >= deadline:
+                return wire.ST_TIMEOUT, {"rid": rid, "timeout_s": timeout}
+            await asyncio.sleep(0.01)
+
+    def _try(self, fleet: _FleetState, body: dict) -> tuple[int, dict]:
+        raw = fleet.kv.try_get(k_result(body["rid"]))
+        if raw is None:
+            return wire.ST_MISSING, {"rid": body["rid"]}
+        return wire.ST_OK, json.loads(raw)
+
+    def _hedge(self, fleet: _FleetState, body: dict) -> tuple[int, dict]:
+        """Duplicate a verdictless, leaseless request onto the next-best
+        replica, excluding wherever we routed it first (hedging onto the
+        suspect straggler is no hedge at all). Claim-once verdicts make
+        the duplicate harmless."""
+        rid = body["rid"]
+        if fleet.kv.try_get(k_result(rid)) is not None:
+            return wire.ST_OK, {"hedged": False, "reason": "verdict"}
+        if fleet.kv.try_get(k_lease(rid)) is not None:
+            return wire.ST_OK, {"hedged": False, "reason": "lease"}
+        raw = fleet.kv.try_get(k_req(rid))
+        if raw is None:
+            return wire.ST_MISSING, {"rid": rid}
+        req = json.loads(raw)
+        self._refresh(fleet)
+        first = fleet.routes.get(rid, "")
+        chain = chain_digest(req["prompt"], fleet.spec.block_size)
+        views = routing.fresh(self._views(fleet), self.max_report_age_s)
+        choice = routing.choose(
+            chain, views, exclude=frozenset({first}) if first else frozenset())
+        if choice is None:
+            enqueue(fleet.kv, rid)
+            replica = ""
+        else:
+            view, _depth = choice
+            enqueue_to(fleet.kv, view.tag, rid)
+            fleet.inflight[view.tag] = fleet.inflight.get(view.tag, 0) + 1
+            replica = view.tag
+        self.stats.hedges += 1
+        return wire.ST_OK, {"hedged": True, "replica": replica}
+
+    def _clear(self, fleet: _FleetState, body: dict) -> tuple[int, dict]:
+        """Clear a terminal SHED verdict so a retry's fresh execution can
+        publish — the socket form of ServeClient._retry's delete pair."""
+        rid = body["rid"]
+        fleet.kv.delete(k_result(rid))
+        fleet.kv.delete(k_done(rid))
+        self.stats.clears += 1
+        return wire.ST_OK, {"rid": rid}
+
+    def _stats_body(self) -> dict:
+        fleets = {}
+        for name, fleet in self._fleets.items():
+            self._refresh(fleet)
+            fleets[name or "default"] = {
+                "replicas": {
+                    v.tag: {"queue_depth": v.queue_depth, "active": v.active,
+                            "pending_local": v.pending_local,
+                            "digest_len": len(v.digest),
+                            "age_s": round(v.age_s, 3)}
+                    for v in self._views(fleet)
+                },
+            }
+        return {"stats": dict(self.stats.__dict__), "fleets": fleets,
+                "admission": self.admission}
+
+
+# -- gateway process main -----------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving gateway: routes client requests across the "
+                    "replica fleet(s) behind one socket endpoint")
+    p.add_argument("--kv-port", type=int,
+                   default=int(os.environ.get(ENV_KV_PORT, "0")))
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--token",
+                   default=os.environ.get("TPU_SANDBOX_GATEWAY_TOKEN"))
+    p.add_argument("--admission", default="feasible",
+                   choices=["feasible", "occupancy", "none"])
+    p.add_argument("--policy", default="prefix",
+                   choices=["prefix", "random"])
+    p.add_argument("--fleets", default=None,
+                   help="JSON list of FleetSpec kwargs; default is the "
+                        "single bare-namespace fleet")
+    args = p.parse_args(argv)
+    if not args.kv_port:
+        p.error(f"--kv-port or {ENV_KV_PORT} required")
+    fleets = None
+    if args.fleets:
+        fleets = [FleetSpec(**f) for f in json.loads(args.fleets)]
+    kv = KVClient(port=args.kv_port)
+    gw = Gateway(kv, fleets, host=args.host, port=args.port,
+                 token=args.token, admission=args.admission,
+                 policy=args.policy)
+    gw.start()
+    print(f"[gateway] listening on {args.host}:{gw.port} "
+          f"(admission={args.admission})", flush=True)
+    stopped = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stopped.set())
+    try:
+        stopped.wait()
+    finally:
+        gw.close()
+        kv.close()
+        print(f"[gateway] closed: {gw.stats.__dict__}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

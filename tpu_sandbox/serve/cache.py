@@ -59,6 +59,21 @@ def _chain_hashes(tokens: Sequence[int], block_size: int) -> list[bytes]:
     return out
 
 
+#: bytes of each chain hash that travel in load-report digests — enough that
+#: an accidental collision is a mis-routed request (a hint gone wrong, never
+#: a correctness problem: the replica's allocator rehashes the full prompt)
+DIGEST_BYTES = 8
+
+
+def chain_digest(tokens: Sequence[int], block_size: int) -> list[str]:
+    """Truncated-hex chain hashes of ``tokens``' full blocks — the compact
+    form both sides of prefix-cache-aware routing speak: replicas advertise
+    their resident set in this form (``PagedKVCache.resident_prefix_digest``)
+    and the gateway computes a request's chain in it."""
+    return [h[:DIGEST_BYTES].hex()
+            for h in _chain_hashes(tokens, block_size)]
+
+
 @dataclass
 class SeqAlloc:
     """Host-side allocation record for one live sequence."""
@@ -91,6 +106,20 @@ class PagedKVCache:
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def resident_prefix_digest(self, top_k: int = 24) -> list[str]:
+        """Truncated-hex digest of the resident prefix-cache entries, newest
+        last — what a replica advertises in its load report so the gateway
+        can route by prefix affinity without extra KV round trips.
+
+        Bounded: at most ``top_k`` entries of ``2 * DIGEST_BYTES`` hex chars
+        each. ``_prefix`` is insertion-ordered and eviction is FIFO, so the
+        *newest* ``top_k`` entries are exactly the ones that will survive
+        block pressure longest — evicted entries drop out of the digest the
+        moment they drop out of the cache (no stale advertisements).
+        """
+        entries = list(self._prefix)[-top_k:]
+        return [h[:DIGEST_BYTES].hex() for h in entries]
 
     def blocks_needed(self, prompt: Sequence[int], max_new: int) -> int:
         total = len(prompt) + max_new

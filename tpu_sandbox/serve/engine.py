@@ -234,7 +234,11 @@ class _EngineBase:
         return n
 
     def load_report(self) -> dict:
-        """Backpressure signals a replica publishes to the KV store."""
+        """Backpressure signals a replica publishes to the KV store.
+
+        ``prefix_digest`` rides along so the gateway can route by prefix
+        affinity from the load reports alone — no extra KV round trips per
+        request (see ``cache.PagedKVCache.resident_prefix_digest``)."""
         now = self.clock()
         cache = self.cache
         return {
@@ -247,6 +251,7 @@ class _EngineBase:
             else now - self.last_step_at,
             "shed": len(self.shed),
             "done": len(self.results),
+            "prefix_digest": cache.resident_prefix_digest(),
         }
 
     # -- shared mechanics ----------------------------------------------------

@@ -10,6 +10,14 @@ the whole request plane:
     serve/lease/<rid>    TTL heartbeat while a replica works the request
     serve/scavenged/<n>  claim-once marker so an orphaned entry is
                          requeued exactly once
+    serve/tq/<tag>/tail  targeted queue: entries the gateway routed to one
+    serve/tq/<tag>/<n>   specific replica (prefix-cache affinity). Only the
+                         owner claims its own targeted entries; peers
+                         scavenge a dead owner's entries back to the shared
+                         queue (see ``scavenge``), so routing is an
+                         optimization, never a new loss case.
+    serve/tclaim/<tag>/<n>  claim-once markers for targeted entries
+    serve/tscav/<tag>/<n>   scavenged-once markers for targeted entries
     serve/result/<rid>   terminal verdict — a token result or an explicit
                          SHED body; idempotent for results (greedy or
                          seeded-sampled decode over bitwise-deterministic
@@ -96,16 +104,34 @@ def k_cmd(tag: str) -> str:
     return f"serve/cmd/{tag}"
 
 
+def k_tq_tail(tag: str) -> str:
+    return f"serve/tq/{tag}/tail"
+
+
+def k_tq(tag: str, seq: int) -> str:
+    return f"serve/tq/{tag}/{seq}"
+
+
+def k_tq_claim(tag: str, seq: int) -> str:
+    return f"serve/tclaim/{tag}/{seq}"
+
+
+def k_tq_scavenged(tag: str, seq: int) -> str:
+    return f"serve/tscav/{tag}/{seq}"
+
+
 # -- producer side -----------------------------------------------------------
 
 
-def submit_request(kv, rid: str, prompt: Sequence[int],
-                   max_new_tokens: int, *, deadline_unix: float | None = None,
-                   temperature: float = 0.0, top_k: int = 0,
-                   seed: int = 0) -> None:
-    """Queue one request. ``deadline_unix`` is wall clock (``time.time()``)
-    so it survives the hop between client and replica processes; replicas
-    translate it to their engine clock at claim time."""
+def write_request(kv, rid: str, prompt: Sequence[int],
+                  max_new_tokens: int, *, deadline_unix: float | None = None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  seed: int = 0) -> None:
+    """Write the request body without enqueueing — the gateway writes the
+    body once, then targets the entry at the replica routing chose.
+    ``deadline_unix`` is wall clock (``time.time()``) so it survives the
+    hop between client and replica processes; replicas translate it to
+    their engine clock at claim time."""
     body = {"rid": rid, "prompt": list(map(int, prompt)),
             "max_new_tokens": int(max_new_tokens)}
     if deadline_unix is not None:
@@ -114,6 +140,16 @@ def submit_request(kv, rid: str, prompt: Sequence[int],
         body.update(temperature=float(temperature), top_k=int(top_k),
                     seed=int(seed))
     kv.set(k_req(rid), json.dumps(body))
+
+
+def submit_request(kv, rid: str, prompt: Sequence[int],
+                   max_new_tokens: int, *, deadline_unix: float | None = None,
+                   temperature: float = 0.0, top_k: int = 0,
+                   seed: int = 0) -> None:
+    """Queue one request on the shared queue (any replica may claim it)."""
+    write_request(kv, rid, prompt, max_new_tokens,
+                  deadline_unix=deadline_unix, temperature=temperature,
+                  top_k=top_k, seed=seed)
     enqueue(kv, rid)
 
 
@@ -121,6 +157,21 @@ def enqueue(kv, rid: str) -> int:
     n = kv.add(K_TAIL) - 1
     kv.set(k_queue(n), rid)
     return n
+
+
+def enqueue_to(kv, tag: str, rid: str) -> int:
+    """Append an entry to one replica's targeted queue. The request body
+    must already be written (``write_request``)."""
+    n = kv.add(k_tq_tail(tag)) - 1
+    kv.set(k_tq(tag, n), rid)
+    return n
+
+
+def targeted_tags(kv) -> list[str]:
+    """Replica tags that have (or had) a targeted queue — scavenge scope."""
+    tags = {k.split("/")[2] for k in kv.keys("serve/tq/")
+            if k.count("/") >= 3}
+    return sorted(tags)
 
 
 def announce_total(kv, total: int) -> None:
@@ -185,6 +236,9 @@ class ReplicaWorker:
         self.scavenge_interval = scavenge_interval or lease_ttl
         self.load_interval = load_interval or lease_ttl / 2
         self._scanned = 0
+        self._tq_scanned = 0  # cursor into our own targeted queue
+        self._tq_hole_slot = -1   # targeted slot seen tail-bumped but empty
+        self._tq_hole_since = 0.0
         self._published: set[str] = set()
         self._next_scavenge = time.monotonic() + self.scavenge_interval
         self._next_load = 0.0  # publish on the first tick
@@ -209,30 +263,35 @@ class ReplicaWorker:
         if results_done(self.kv):
             return False
         self._poll_faults()
+        # targeted entries first (the gateway routed them here for prefix
+        # affinity — serving them elsewhere wastes the resident cache), then
+        # top up from the shared queue
+        tq_tail = int(self.kv.try_get(k_tq_tail(self.tag)) or b"0")
+        while self._tq_scanned < tq_tail \
+                and self._local_load() < self.claim_depth:
+            n = self._tq_scanned
+            rid_raw = self.kv.try_get(k_tq(self.tag, n))
+            if rid_raw is None:
+                # tail bumped, entry body not visible yet (the producer is
+                # mid-write). We are the only claimer of this queue, so
+                # skipping would strand the request forever — peers defer
+                # to a live owner. Hold the cursor and retry, advancing
+                # only once the hole proves permanent (producer died
+                # between bump and set: no rid was ever written, so
+                # nothing is lost by moving on).
+                if self._tq_hole_slot != n:
+                    self._tq_hole_slot = n
+                    self._tq_hole_since = time.monotonic()
+                elif time.monotonic() - self._tq_hole_since > self.lease_ttl:
+                    self._tq_scanned += 1
+                break
+            self._tq_scanned += 1
+            self._claim_entry(rid_raw, k_tq_claim(self.tag, n))
         tail = int(self.kv.try_get(K_TAIL) or b"0")
         while self._scanned < tail and self._local_load() < self.claim_depth:
             n = self._scanned
             self._scanned += 1
-            rid_raw = self.kv.try_get(k_queue(n))
-            if rid_raw is None:
-                continue  # tail bumped, entry body not written yet: revisit
-            rid = rid_raw.decode()
-            if self.kv.try_get(k_result(rid)) is not None:
-                continue
-            # lease before claim: a scavenger never sees a fresh claim
-            # without a heartbeat (spurious requeues would still be safe,
-            # just wasted work)
-            self.kv.set_ttl(k_lease(rid), self.tag, self.lease_ttl)
-            if self.kv.add(k_claim(n)) != 1:
-                continue
-            body = json.loads(self.kv.get(k_req(rid)))
-            # a rid can come around again legitimately: a client that saw
-            # our SHED verdict cleared it and re-enqueued. Forget that we
-            # published, so the fresh execution's verdict goes out too
-            # (the claim-once serve/done marker still arbitrates races).
-            self._published.discard(rid)
-            self.engine.submit(self._to_request(body))
-            self.stats.claimed += 1
+            self._claim_entry(self.kv.try_get(k_queue(n)), k_claim(n))
         if not self.engine.idle:
             self.engine.step()
         self._heartbeat()
@@ -250,6 +309,33 @@ class ReplicaWorker:
                 raise TimeoutError(f"replica {self.tag} timed out")
             if self.engine.idle:
                 time.sleep(poll)
+
+    def _claim_entry(self, rid_raw: bytes | None, claim_key: str) -> bool:
+        """Lease-then-claim one queue entry into the local engine. False
+        when the entry is absent (tail bumped, body not written yet —
+        shared-queue scans revisit via scavenge; targeted scans hold the
+        cursor and retry, since only the owner claims there), already
+        resulted, or lost the claim race."""
+        if rid_raw is None:
+            return False
+        rid = rid_raw.decode()
+        if self.kv.try_get(k_result(rid)) is not None:
+            return False
+        # lease before claim: a scavenger never sees a fresh claim
+        # without a heartbeat (spurious requeues would still be safe,
+        # just wasted work)
+        self.kv.set_ttl(k_lease(rid), self.tag, self.lease_ttl)
+        if self.kv.add(claim_key) != 1:
+            return False
+        body = json.loads(self.kv.get(k_req(rid)))
+        # a rid can come around again legitimately: a client that saw
+        # our SHED verdict cleared it and re-enqueued. Forget that we
+        # published, so the fresh execution's verdict goes out too
+        # (the claim-once serve/done marker still arbitrates races).
+        self._published.discard(rid)
+        self.engine.submit(self._to_request(body))
+        self.stats.claimed += 1
+        return True
 
     def _to_request(self, body: dict):
         """Queue-entry body -> engine Request, translating the wall-clock
@@ -287,7 +373,10 @@ class ReplicaWorker:
 
     def drain(self) -> int:
         """Requeue everything in flight; the SIGTERM path. Finished-but-
-        unpublished verdicts go out first so nothing computed is lost."""
+        unpublished verdicts go out first so nothing computed is lost.
+        Targeted entries we never even claimed are handed back too —
+        claimed first (so the scavenger can't requeue them a second time),
+        then re-enqueued on the shared queue for any peer."""
         self._publish_new()
         requests = self.engine.drain_to_requests()
         for req in requests:
@@ -297,11 +386,37 @@ class ReplicaWorker:
             enqueue(self.kv, req.rid)
             self.kv.delete(k_lease(req.rid))
             self.stats.requeued += 1
+        tq_tail = int(self.kv.try_get(k_tq_tail(self.tag)) or b"0")
+        for n in range(tq_tail):
+            if self.kv.try_get(k_tq_claim(self.tag, n)) is not None:
+                continue  # claimed: drained above or already resulted
+            rid_raw = self.kv.try_get(k_tq(self.tag, n))
+            if rid_raw is None:
+                continue
+            rid = rid_raw.decode()
+            if self.kv.try_get(k_result(rid)) is not None:
+                continue
+            if self.kv.add(k_tq_claim(self.tag, n)) != 1:
+                continue  # a scavenger beat us to it
+            # mark moved-to-shared so a later scavenger (seeing a claimed,
+            # leaseless, unresulted entry) doesn't requeue it a second time
+            self.kv.add(k_tq_scavenged(self.tag, n))
+            enqueue(self.kv, rid)
+            self.stats.requeued += 1
         return self.stats.requeued
 
     def scavenge(self) -> int:
         """Requeue claimed entries whose worker went silent (no lease, no
-        result). Each entry is requeued at most once, by one scavenger."""
+        result). Each entry is requeued at most once, by one scavenger.
+
+        Targeted queues are covered too: only the owner scans its own
+        queue, so a dead replica's routed entries would otherwise sit
+        unclaimed forever. An unclaimed targeted entry is rescued once the
+        owner's TTL'd load report is gone (dead or wedged past the TTL); a
+        claimed-and-leaseless one is rescued exactly like a shared entry.
+        Rescues land on the SHARED queue — the owner is presumed dead, any
+        peer may serve. A spurious rescue (owner merely slow) wastes
+        compute, never correctness: verdicts stay claim-once."""
         n_rescued = 0
         tail = int(self.kv.try_get(K_TAIL) or b"0")
         for n in range(tail):
@@ -319,6 +434,32 @@ class ReplicaWorker:
                 continue  # another scavenger took this entry
             enqueue(self.kv, rid)
             n_rescued += 1
+        for tag in targeted_tags(self.kv):
+            owner_alive = tag == self.tag \
+                or self.kv.try_get(k_load(tag)) is not None
+            tq_tail = int(self.kv.try_get(k_tq_tail(tag)) or b"0")
+            for n in range(tq_tail):
+                rid_raw = self.kv.try_get(k_tq(tag, n))
+                if rid_raw is None:
+                    continue
+                rid = rid_raw.decode()
+                if self.kv.try_get(k_result(rid)) is not None:
+                    continue
+                if self.kv.try_get(k_lease(rid)) is not None:
+                    continue
+                claimed = self.kv.try_get(k_tq_claim(tag, n)) is not None
+                if not claimed and owner_alive:
+                    continue  # owner will claim it in its own time
+                if tag == self.tag and not claimed:
+                    continue  # our own backlog: tick claims it, not scavenge
+                if self.kv.add(k_tq_scavenged(tag, n)) != 1:
+                    continue
+                # claim the original too, so a resurrected owner does not
+                # re-execute it (racy owners only waste compute; verdict
+                # publication stays claim-once either way)
+                self.kv.add(k_tq_claim(tag, n))
+                enqueue(self.kv, rid)
+                n_rescued += 1
         self.stats.scavenged += n_rescued
         return n_rescued
 
@@ -331,12 +472,17 @@ class ReplicaWorker:
             self.kv.set_ttl(k_lease(req.rid), self.tag, self.lease_ttl)
 
     def _publish_new(self) -> None:
+        # tokens are bitwise identical across executions of a rid; the
+        # ttft_s timing metadata is execution-specific, which is fine —
+        # the claim-once serve/done marker means exactly one body lands,
+        # and timing is observability, not an answer
         for rid, res in self.engine.results.items():
             if rid in self._published:
                 continue
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "ok", "tokens": res.tokens,
-                "preemptions": res.preemptions, "replica": self.tag})
+                "preemptions": res.preemptions, "replica": self.tag,
+                "ttft_s": round(res.ttft, 6)})
             self.stats.completed += 1
         for rid, rec in self.engine.shed.items():
             if rid in self._published:
@@ -404,6 +550,11 @@ def main(argv: Sequence[str] | None = None) -> int:
                    help="JSON: model/cache/max_batch/buckets/param_seed/"
                         "lease-ttl overrides")
     p.add_argument("--tag", default=None)
+    p.add_argument("--fleet", default=os.environ.get(
+        "TPU_SANDBOX_FLEET", ""),
+        help="tenant fleet this replica serves: its whole request plane "
+             "lives under fleet/<name>/ so several model fleets share one "
+             "store behind one gateway")
     args = p.parse_args(argv)
     cfg = json.loads(args.config)
 
@@ -412,6 +563,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         f"replica-a{os.environ.get('TPU_SANDBOX_AGENT_ID', '?')}"
         f"-g{os.environ.get('TPU_SANDBOX_GENERATION', '?')}")
     kv = KVClient(port=port)
+    if args.fleet:
+        from tpu_sandbox.gateway.fleet import fleet_kv
+
+        kv = fleet_kv(kv, args.fleet)
     worker = ReplicaWorker(
         kv, _build_engine(cfg), tag=tag,
         lease_ttl=float(cfg.get("lease_ttl", 3.0)))
