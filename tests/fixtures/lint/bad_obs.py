@@ -1,0 +1,37 @@
+"""Seeded GL-O401 violations: spans begun outside the sanctioned
+shapes. Each leaks its record from the merged timeline on some path.
+Parsed by the linter, never imported."""
+
+from tpu_sandbox.obs import get_recorder
+
+
+def route_one(rid):
+    pass
+
+
+def happy_path_only(rid):
+    # close() is reached only when route_one does not raise — the span
+    # leaks on every error path
+    rec = get_recorder()
+    sp = rec.begin_span("route", args={"rid": rid})
+    route_one(rid)
+    sp.close()
+
+
+def handle_discarded(rid):
+    # nothing holds the span, so nothing can ever close it
+    rec = get_recorder()
+    rec.begin_span("enqueue", args={"rid": rid})
+    route_one(rid)
+
+
+def work_before_the_try(rid):
+    # the try/finally is there, but route_one sits between the begin
+    # and the try — an exception in it leaks the span
+    rec = get_recorder()
+    sp = rec.begin_span("claim", args={"rid": rid})
+    route_one(rid)
+    try:
+        route_one(rid)
+    finally:
+        sp.close()

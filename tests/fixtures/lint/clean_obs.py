@@ -1,0 +1,37 @@
+"""Guarded twins for GL-O401: every sanctioned span shape, none of
+which may trip the rule. Parsed by the linter, never imported."""
+
+from tpu_sandbox.obs import get_recorder
+
+
+def route_one(rid):
+    pass
+
+
+def with_block(rid):
+    # the preferred spelling: closes on every path by construction
+    rec = get_recorder()
+    with rec.span("route", args={"rid": rid}) as sp:
+        route_one(rid)
+        return sp.ctx
+
+
+def explicit_try_finally(rid):
+    # begin_span is allowed when the try/finally follows immediately
+    rec = get_recorder()
+    sp = rec.begin_span("claim", args={"rid": rid})
+    try:
+        route_one(rid)
+    finally:
+        sp.close()
+
+
+def retrospective(rid, t0):
+    # complete() emits in one shot — it cannot leak
+    rec = get_recorder()
+    return rec.complete("decode", t0, args={"rid": rid})
+
+
+def point_event(rid):
+    rec = get_recorder()
+    return rec.instant("verdict", args={"rid": rid})

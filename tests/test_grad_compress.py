@@ -191,7 +191,12 @@ def test_bf16_mode_tracks_fp32(mesh8):
         s_ref.params)
 
 
-@pytest.mark.parametrize("block", [256, 4096])
+@pytest.mark.parametrize(
+    "block",
+    [256,
+     # the large-block twin re-proves the margin-grows-with-block-size
+     # corollary; one full 3x55-step convergence run is enough for tier-1
+     pytest.param(4096, marks=pytest.mark.slow)])
 def test_int8_ef_convergence_tracks_fp32(mesh8, block):
     """THE acceptance criterion: over >= 50 steps (momentum SGD, the
     reference's training config), int8 + error feedback lands on the

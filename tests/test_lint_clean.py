@@ -106,6 +106,25 @@ def test_clean_control_fixture_passes():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_bad_obs_fixture_fires_gl_o401():
+    findings = lint_ctrl(_fixture("bad_obs.py"), "bad_obs.py")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # the obs fixture trips ONLY the span-leak rule — three spellings
+    assert set(by_rule) == {"GL-O401"}
+    assert len(by_rule["GL-O401"]) == 3
+    msgs = "\n".join(f.message for f in by_rule["GL-O401"])
+    assert "discarded" in msgs          # handle_discarded
+    assert "'sp'" in msgs               # assigned-but-unguarded spellings
+    assert all(f.line > 0 and f.hint for f in findings)
+
+
+def test_clean_obs_fixture_passes():
+    findings = lint_ctrl(_fixture("clean_obs.py"), "clean_obs.py")
+    assert findings == [], [f.format() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # Pass 2 fixtures (pure layers; the compile layer runs in the subprocess
 # gate below)
@@ -226,7 +245,7 @@ def test_baseline_parser_rejects_malformed():
 
 def test_rule_catalog_is_complete():
     prefixes = {r[:5] for r in RULES}
-    assert prefixes == {"GL-C1", "GL-H2", "GL-R3"}
+    assert prefixes == {"GL-C1", "GL-H2", "GL-R3", "GL-O4"}
     assert all(title and hint for title, hint in RULES.values())
 
 

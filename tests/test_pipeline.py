@@ -156,6 +156,9 @@ def test_circular_schedule_matches_single_device(chunks):
     assert_matches_dense_reference(pp, cfg, tokens, targets, tx)
 
 
+@pytest.mark.slow  # two full pipeline compiles for a design-property
+# receipt that only moves when stage partitioning changes; the 4d parity
+# test keeps pipeline correctness in tier-1
 def test_per_stage_flops_do_not_scale_with_n_stages():
     """VERDICT r01 weak #3's done-criterion, checked by XLA's own cost
     analysis: the cond-gated embed/head means a device's compiled FLOPs for

@@ -1,0 +1,130 @@
+"""utils/profiling.py coverage: the trace() wrapper (including the
+newer-jax ``start_trace`` signature fallback), region annotation, the
+fetch-synced host_sync primitive, StepTimer, and the differential
+per-step measurement — all on CPU with stubbed profilers where the real
+one would write trace directories."""
+
+import math
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_sandbox.utils import profiling
+
+
+class _StubProfiler:
+    """Records start/stop calls; optionally rejects the tracer-options
+    kwarg the way newer jax releases do."""
+
+    def __init__(self, accepts_options: bool):
+        self.accepts_options = accepts_options
+        self.calls = []
+
+    def start_trace(self, logdir, **kwargs):
+        if kwargs and not self.accepts_options:
+            raise TypeError(
+                "start_trace() got an unexpected keyword argument "
+                f"{next(iter(kwargs))!r}")
+        self.calls.append(("start", logdir, dict(kwargs)))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+def test_trace_passes_tracer_options_when_supported(monkeypatch, tmp_path):
+    stub = _StubProfiler(accepts_options=True)
+    monkeypatch.setattr(profiling.jax, "profiler", stub)
+    with profiling.trace(str(tmp_path), host_tracer_level=3):
+        pass
+    assert stub.calls == [
+        ("start", str(tmp_path), {"host_tracer_level": 3}),
+        ("stop",),
+    ]
+
+
+def test_trace_falls_back_when_start_trace_rejects_options(
+        monkeypatch, tmp_path):
+    # newer jax moved tracer options off start_trace: the first attempt
+    # raises TypeError and trace() must retry bare, not propagate
+    stub = _StubProfiler(accepts_options=False)
+    monkeypatch.setattr(profiling.jax, "profiler", stub)
+    with profiling.trace(str(tmp_path)):
+        pass
+    assert stub.calls == [("start", str(tmp_path), {}), ("stop",)]
+
+
+def test_trace_stops_profiler_on_body_exception(monkeypatch, tmp_path):
+    stub = _StubProfiler(accepts_options=True)
+    monkeypatch.setattr(profiling.jax, "profiler", stub)
+    with pytest.raises(RuntimeError, match="boom"):
+        with profiling.trace(str(tmp_path)):
+            raise RuntimeError("boom")
+    assert stub.calls[-1] == ("stop",)
+
+
+def test_annotate_names_a_region():
+    # the real TraceAnnotation is a cheap no-op off-profiler; the context
+    # must simply nest without error
+    with profiling.annotate("outer"):
+        with profiling.annotate("inner"):
+            pass
+
+
+def test_host_sync_fetches_a_data_dependent_scalar():
+    x = jnp.arange(8, dtype=jnp.float32) + 1.0
+    assert profiling.host_sync(x) == 1.0
+    assert profiling.host_sync(jnp.zeros((2, 3))) == 0.0
+
+
+def test_step_timer_warmup_and_rates():
+    t = profiling.StepTimer(warmup=1)
+    t.start()
+    for _ in range(3):
+        time.sleep(0.002)
+        t.tick(n_items=4)
+    # warmup discards the first step: two measured
+    assert len(t.step_times) == 2
+    assert t.seconds_per_step >= 0.002
+    assert t.items_per_second == pytest.approx(
+        8 / sum(t.step_times))
+
+
+def test_step_timer_tick_before_start_only_arms():
+    t = profiling.StepTimer(warmup=0)
+    t.tick(n_items=4)  # no start(): arms the clock, measures nothing
+    assert t.step_times == []
+    assert math.isnan(t.seconds_per_step)
+    assert math.isnan(t.items_per_second)
+    time.sleep(0.001)
+    t.tick(n_items=4)
+    assert len(t.step_times) == 1
+
+
+def test_measure_per_step_cancels_fixed_costs():
+    fixed, per_step = 0.004, 0.001
+
+    def run_steps(k):
+        time.sleep(fixed + per_step * k)
+        return jnp.ones((1,))
+
+    out = profiling.measure_per_step(run_steps, n=4)
+    assert out["n"] == 4
+    assert out["t_2n_sec"] > out["t_n_sec"]
+    # the constant cost cancels: the estimate tracks per_step, not
+    # fixed + per_step
+    assert out["sec_per_step"] == pytest.approx(per_step, rel=0.75)
+    assert "differential" in out["timing_method"]
+
+
+def test_measure_per_step_repeated_publishes_spread():
+    def run_steps(k):
+        time.sleep(0.001 * k)
+        return jnp.ones((1,))
+
+    out = profiling.measure_per_step_repeated(run_steps, n=2, repeats=2)
+    assert out["repeats"] == 2
+    assert len(out["sec_per_step_samples"]) == 2
+    assert out["sec_per_step"] > 0
+    if out["spread_frac"] is not None:
+        assert out["spread_frac"] >= 0

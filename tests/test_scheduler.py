@@ -552,7 +552,9 @@ def test_weighted_fair_share_converges_to_tenant_weights(agent_script):
     submitted interleaved (so raw seq order favours neither) and all have
     the same duration; the admission order must track virtual time, i.e.
     at every decision point the normalised service |served_a/2 - served_b|
-    stays within one job of balanced.  Plain FIFO would drift to 1.5."""
+    stays within one job of balanced.  Plain FIFO would drift to 1.5.
+    Jobs are short — the property is about admission ORDER, and vtime
+    normalises by duration, so only equality of durations matters."""
     alpha = [f"a{i}" for i in range(6)]
     beta = [f"b{i}" for i in range(3)]
     with ClusterScheduler(1, poll=0.02, extra_env=ENV,
@@ -565,7 +567,7 @@ def test_weighted_fair_share_converges_to_tenant_weights(agent_script):
             sched.submit(JobSpec(
                 job_id=jid, hosts=1, world_size=1, tenant=tenant,
                 share=share,
-                agent_argv=_agent_argv(agent_script, "work", 0.5)))
+                agent_argv=_agent_argv(agent_script, "work", 0.2)))
         states = sched.serve(timeout=120)
         assert all(s == "done" for s in states.values()), states
         admitted = sorted(
@@ -585,6 +587,8 @@ def test_weighted_fair_share_converges_to_tenant_weights(agent_script):
         assert 0.4 < va / vb < 2.5, (va, vb)
 
 
+@pytest.mark.slow  # ~12s of subprocess scheduler work; tier-1 keeps the
+# in-process convergence test above plus both death-adoption kill orders
 def test_vtime_ledger_survives_scheduler_death(agent_script):
     """Satellite: kill the scheduler mid-run; the successor must restore
     the per-tenant virtual-time ledger from sched/vtime/<tenant> and keep

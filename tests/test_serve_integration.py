@@ -28,6 +28,7 @@ in test_serve.py.
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -143,6 +144,7 @@ def test_replica_gang_survives_agent_kill_with_zero_loss(tmp_path):
                 "--agents", "2", "--kv-port", str(kv_port),
                 "--config", cfg_json]
 
+    trace_dir = tmp_path / "trace"
     launcher = AgentLauncher(
         2, agent_cmd, kv_server=server,
         extra_env={
@@ -151,6 +153,9 @@ def test_replica_gang_survives_agent_kill_with_zero_loss(tmp_path):
             # draw params from the same threefry stream or the reference
             # and the gang disagree from token 0
             "JAX_THREEFRY_PARTITIONABLE": "1",
+            # flight recorder on in every agent/replica process: the
+            # postmortem below reconstructs the incident from these logs
+            "TPU_SANDBOX_TRACE_DIR": str(trace_dir),
             "PYTHONPATH": str(REPO) + os.pathsep
             + os.environ.get("PYTHONPATH", ""),
         })
@@ -198,6 +203,27 @@ def test_replica_gang_survives_agent_kill_with_zero_loss(tmp_path):
         tail = int(kv.get(R.K_TAIL))
         assert tail > N_REQUESTS, \
             f"no requeues observed (tail {tail} == {N_REQUESTS})"
+
+        # postmortem receipt: tracecat over the durable recorder logs
+        # reconstructs the incident in causal order — the fault firing,
+        # the dead claimant's lease expiring, the scavenger's requeue.
+        # Instants are flushed before the SIGKILL executes, so the kill
+        # record survives the process that wrote it.
+        def tracecat(*args):
+            proc = subprocess.run(
+                [sys.executable, str(REPO / "tools" / "tracecat.py"),
+                 str(trace_dir), *args],
+                capture_output=True, text=True, timeout=120)
+            assert proc.returncode == 0, proc.stderr
+            return proc.stdout
+        timeline = tracecat("--last", "600s")
+        i_kill = timeline.index("fault:kill_agent")
+        i_expire = timeline.index("lease:expired")
+        i_requeue = timeline.index("scavenge:requeue")
+        assert i_kill < i_expire < i_requeue, timeline
+        # the exact incident-response invocation works too: the window is
+        # measured back from the LAST record, so it always has content
+        assert tracecat("--last", "10s").strip()
     finally:
         if thread.is_alive():
             # unwedge the launcher so teardown can't hang the suite

@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""tracecat — merge flight-recorder logs and render them.
+
+Reads a directory of per-process recorder JSONL files (written wherever
+``TPU_SANDBOX_TRACE_DIR`` pointed), merges them onto one clock via the
+KV-sequencer calibration, and renders one of:
+
+    python tools/tracecat.py LOGDIR --out trace.json
+        Chrome/Perfetto trace-event JSON. Open at https://ui.perfetto.dev
+        (or chrome://tracing): one track per process, spans nested,
+        fault injections as instant events.
+
+    python tools/tracecat.py LOGDIR --rid r0007
+        Per-request waterfall: every span of that request's trace,
+        ordered and indented by causal depth.
+
+    python tools/tracecat.py LOGDIR --last 10s
+        Postmortem: causally-ordered text timeline of the final N
+        seconds before the logs went quiet — kills, lease expiries,
+        scavenge requeues, in order, across every process.
+
+With no mode flag it prints a summary: processes, record counts, trace
+chains and their integrity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_sandbox.obs import collect  # noqa: E402
+
+
+def _parse_seconds(text: str) -> float:
+    text = text.strip().lower()
+    if text.endswith("s"):
+        text = text[:-1]
+    return float(text)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tracecat", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("logdir", help="directory of recorder *.jsonl files")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write merged Chrome trace-event JSON here")
+    ap.add_argument("--rid", metavar="RID",
+                    help="print the waterfall for one request id")
+    ap.add_argument("--trace", metavar="TRACE_ID",
+                    help="print the waterfall for one trace id")
+    ap.add_argument("--last", metavar="DUR",
+                    help="print the postmortem timeline of the final "
+                         "window, e.g. --last 10s")
+    args = ap.parse_args(argv)
+
+    logs = collect.load_dir(args.logdir)
+    if not logs:
+        print(f"no recorder logs under {args.logdir}", file=sys.stderr)
+        return 1
+    offsets = collect.clock_offsets(logs)
+    merged = collect.merge(logs, offsets)
+
+    did_something = False
+    if args.out:
+        trace = collect.to_chrome_trace(merged)
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh)
+        print(f"wrote {len(trace['traceEvents'])} events to {args.out} "
+              f"(open at https://ui.perfetto.dev)")
+        did_something = True
+    if args.rid or args.trace:
+        rows = collect.request_waterfall(merged, rid=args.rid,
+                                         trace=args.trace)
+        if not rows:
+            print("no matching trace", file=sys.stderr)
+            return 1
+        print(collect.format_waterfall(rows))
+        did_something = True
+    if args.last:
+        window = collect.last_window(merged, _parse_seconds(args.last))
+        print(collect.format_timeline(window))
+        did_something = True
+
+    if not did_something:
+        print(f"{len(logs)} process logs, {len(merged)} records")
+        for key in sorted(logs):
+            print(f"  {key}: {len(logs[key])} records "
+                  f"(offset {offsets.get(key, 0.0):+.6f}s)")
+        chains = collect.trace_chains(merged)
+        ok = sum(1 for recs in chains.values()
+                 if collect.chain_check(recs)["connected"])
+        print(f"{len(chains)} traces, {ok} fully connected")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
-"""Pass 3: control-plane lint over ``runtime/``, ``serve/`` and
-``gateway/`` (AST).
+"""Pass 3: control-plane lint over ``runtime/``, ``serve/``,
+``gateway/`` and ``obs/`` (AST).
 
-Six rules distilled from this repo's own elastic-runtime and serving
+Seven rules distilled from this repo's own elastic-runtime and serving
 incident history:
 
 - **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
@@ -39,6 +39,15 @@ incident history:
   ``serve/engine.ContinuousEngine.submit`` idiom). ``appendleft`` is
   deliberately exempt: requeueing already-admitted work (preemption)
   adds nothing the queue has not already accepted.
+- **GL-O401** — a span begun with ``begin_span()`` whose ``close()`` is
+  not guaranteed on every path. The sanctioned forms are ``with
+  rec.span(...)`` or ``sp = rec.begin_span(...)`` followed
+  *immediately* by a ``try`` whose ``finally`` calls ``sp.close()``.
+  Anything looser (a bare call whose handle is discarded, work between
+  the begin and the ``try``, a close only on the happy path) can leak
+  the span: a leaked open span never emits its record, so the request
+  silently vanishes from the merged timeline — the observability
+  equivalent of a lost verdict.
 """
 
 from __future__ import annotations
@@ -65,6 +74,28 @@ QUEUE_NAMES = frozenset({
 #: call-name substrings that mark a function as overload-aware — it has
 #: somewhere to put work it refuses (shed verdicts, drop/evict paths)
 SHED_MARKERS = ("shed", "drop", "reject", "evict")
+
+
+#: nested scopes a statement walk must not descend into — each is
+#: linted as its own function/class
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _stmt_lists(fn: ast.AST):
+    """Yield every statement sequence under ``fn`` (bodies, else/finally
+    arms, except handlers, match cases) without descending into nested
+    function/class scopes."""
+    stack: list[ast.AST] = [fn]
+    while stack:
+        cur = stack.pop()
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(cur, field, None)
+            if isinstance(stmts, list):
+                yield stmts
+                stack.extend(
+                    s for s in stmts if not isinstance(s, _SCOPE_NODES))
+        stack.extend(getattr(cur, "handlers", ()))
+        stack.extend(getattr(cur, "cases", ()))
 
 
 def _is_queueish(name: str | None) -> bool:
@@ -394,6 +425,57 @@ class _FnLinter:
                 f"path — overload grows this queue without bound",
             )
 
+    # -- GL-O401 -------------------------------------------------------------
+
+    @staticmethod
+    def _is_begin_span(expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Call) \
+            and _final_attr(expr.func) == "begin_span"
+
+    @staticmethod
+    def _finally_closes(tryst: ast.Try, name: str) -> bool:
+        for stmt in tryst.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "close" \
+                        and _final_attr(sub.func.value) == name:
+                    return True
+        return False
+
+    def _check_span_leaks(self, fn: ast.AST) -> None:
+        """``begin_span()`` must be the sanctioned shape: the handle
+        assigned, then IMMEDIATELY a ``try`` whose ``finally`` closes
+        it. A discarded handle, or any statement between the begin and
+        the ``try``, is a path on which the span never emits — it
+        silently vanishes from the merged timeline. (``with
+        rec.span(...)`` compiles to this shape inside the recorder and
+        is the preferred spelling.)"""
+        for stmts in _stmt_lists(fn):
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, ast.Expr) \
+                        and self._is_begin_span(stmt.value):
+                    self._emit(
+                        "GL-O401", stmt,
+                        "begin_span() handle discarded — nothing can "
+                        "ever close this span",
+                    )
+                    continue
+                if not (isinstance(stmt, ast.Assign)
+                        and self._is_begin_span(stmt.value)):
+                    continue
+                name = _final_attr(stmt.targets[0])
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                if name is not None and isinstance(nxt, ast.Try) \
+                        and self._finally_closes(nxt, name):
+                    continue
+                self._emit(
+                    "GL-O401", stmt,
+                    f"span '{name}' begun without an immediate "
+                    f"try/finally close — an exception before close() "
+                    f"leaks it from the timeline",
+                )
+
     # -- GL-R304 (per-class, run separately) ---------------------------------
 
     def run_common(self, fn: ast.AST) -> None:
@@ -406,6 +488,7 @@ class _FnLinter:
         self._check_stamp_math(fn)
         self._check_threads(fn)
         self._check_unbounded_queues(fn)
+        self._check_span_leaks(fn)
 
 
 def _leader_reachable(cls: ast.ClassDef) -> set[str]:
@@ -623,11 +706,11 @@ def lint_source(source: str, path: str) -> list[Finding]:
 def run_control_pass(
     root: str, *, paths: list[str] | None = None,
 ) -> list[Finding]:
-    """Lint ``runtime/`` + ``serve/`` + ``gateway/`` (or explicit
-    ``paths``); labels are root-relative."""
+    """Lint ``runtime/`` + ``serve/`` + ``gateway/`` + ``obs/`` (or
+    explicit ``paths``); labels are root-relative."""
     if paths is None:
         paths = []
-        for pkg in ("runtime", "serve", "gateway"):
+        for pkg in ("runtime", "serve", "gateway", "obs"):
             pkg_dir = os.path.join(root, "tpu_sandbox", pkg)
             if os.path.isdir(pkg_dir):
                 for fn in sorted(os.listdir(pkg_dir)):

@@ -7,6 +7,7 @@ baseline suppressor all speak one format. Rule ids are grouped by pass:
 - ``GL-C1xx``  Pass 1: collective consistency (AST, SPMD-divergence class)
 - ``GL-H2xx``  Pass 2: jaxpr / chipless AOT HLO step lint
 - ``GL-R3xx``  Pass 3: control-plane lint (AST over runtime/ + serve/)
+- ``GL-O4xx``  Pass 3 observability rules (span/recorder discipline)
 """
 
 from __future__ import annotations
@@ -119,6 +120,14 @@ RULES: dict[str, tuple[str, str]] = {
         "and no shed path turns overload into unbounded memory growth and "
         "unbounded tail latency; bound the queue and shed with an explicit "
         "verdict (see serve/engine.ContinuousEngine.submit)",
+    ),
+    # -- Pass 3: observability discipline ------------------------------------
+    "GL-O401": (
+        "span begun without a guaranteed close",
+        "a leaked open span never emits its record and the request "
+        "silently vanishes from the merged timeline; use `with "
+        "rec.span(...)`, or assign `sp = rec.begin_span(...)` and follow "
+        "it IMMEDIATELY with try/finally sp.close()",
     ),
 }
 

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass
 
 from tpu_sandbox.gateway import wire
+from tpu_sandbox.obs import get_recorder, get_registry
 from tpu_sandbox.serve.client import ClientStats
 
 
@@ -121,7 +122,12 @@ class GatewayClient:
         if p.temperature > 0.0:
             body.update(temperature=p.temperature, top_k=p.top_k,
                         seed=p.seed)
-        _status, resp = self._checked(wire.OP_SUBMIT, body)
+        # the trace ROOT: every downstream span of this request chains
+        # back to this submit via the tc carried in the wire frame
+        with get_recorder().span("submit", args={"rid": rid}) as sp:
+            if sp.ctx is not None:
+                body["tc"] = sp.ctx.to_wire()
+            _status, resp = self._checked(wire.OP_SUBMIT, body)
         return bool(resp.get("admitted"))
 
     def result(self, rid: str, timeout: float = 60.0) -> dict:
@@ -159,6 +165,7 @@ class GatewayClient:
         self._checked(wire.OP_CLEAR, {"rid": rid})
         self._submit_body(rid, p)  # fresh deadline, fresh routing
         self.stats.retries += 1
+        get_registry().counter("client.retries").inc()
 
     def _maybe_hedge(self, rid: str, p: _Pending) -> None:
         if p.hedged or self.hedge_after is None:
@@ -171,6 +178,7 @@ class GatewayClient:
         if status == wire.ST_OK and resp.get("hedged"):
             p.hedged = True
             self.stats.hedges += 1
+            get_registry().counter("client.hedges").inc()
 
     # -- extras ---------------------------------------------------------------
 
@@ -180,4 +188,11 @@ class GatewayClient:
 
     def gateway_stats(self) -> dict:
         _status, body = self._checked(wire.OP_STATS, {})
+        return body
+
+    def metrics(self) -> dict:
+        """Live fleet metrics scrape: the gateway's registry snapshot,
+        its recorder stats, and per-replica recorder stats riding the
+        TTL'd load reports."""
+        _status, body = self._checked(wire.OP_METRICS, {})
         return body

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 from tpu_sandbox.gateway import wire
 from tpu_sandbox.gateway import routing
 from tpu_sandbox.gateway.fleet import DEFAULT_FLEET, FleetSpec, fleet_kv
+from tpu_sandbox.obs import get_recorder, get_registry
 from tpu_sandbox.runtime.kvstore import KVClient
 from tpu_sandbox.runtime.supervisor import ENV_KV_PORT
 from tpu_sandbox.serve.cache import chain_digest
@@ -268,6 +269,8 @@ class Gateway:
     async def _dispatch(self, op: int, body: dict) -> tuple[int, dict]:
         if op == wire.OP_STATS:
             return wire.ST_OK, self._stats_body()
+        if op == wire.OP_METRICS:
+            return wire.ST_OK, self._metrics_body()
         try:
             fleet = self._fleet_of(body)
         except KeyError as e:
@@ -342,6 +345,8 @@ class Gateway:
         deadline_s = body.get("deadline_s")
         if deadline_s is not None:
             deadline_s = float(deadline_s)
+        rec = get_recorder()
+        t_route = time.monotonic()
         self._refresh(fleet)
         chain = chain_digest(prompt, fleet.spec.block_size)
         views = routing.fresh(self._views(fleet), self.max_report_age_s)
@@ -354,8 +359,12 @@ class Gateway:
             # nobody has reported yet (fleet warming up): nothing to
             # estimate against, so admit to the shared queue — engine-side
             # guardrails still apply once a replica claims it
-            self._enqueue_request(fleet, body, rid, prompt, max_new,
-                                  deadline_s, target=None)
+            route_ctx = rec.complete("route", t_route, parent=body.get("tc"),
+                                     args={"rid": rid, "routed": "shared"})
+            with rec.span("enqueue", parent=route_ctx,
+                          args={"rid": rid}) as sp:
+                self._enqueue_request(fleet, body, rid, prompt, max_new,
+                                      deadline_s, target=None, tc=sp.ctx)
             self.stats.routed_shared += 1
             self.stats.admitted += 1
             return wire.ST_OK, {"admitted": True, "replica": "",
@@ -366,13 +375,20 @@ class Gateway:
             service_rate_rps=fleet.spec.service_rate_rps,
             deadline_s=deadline_s,
             occupancy_bound=fleet.spec.occupancy_bound)
+        route_ctx = rec.complete("route", t_route, parent=body.get("tc"),
+                                 args={"rid": rid, "replica": view.tag})
         if not ok:
-            self._door_shed(fleet, rid, reason, est)
+            # the trace's terminal span for a door shed: door:<reason>
+            with rec.span(f"door:{reason}", parent=route_ctx,
+                          args={"rid": rid}):
+                self._door_shed(fleet, rid, reason, est)
             return wire.ST_OK, {"admitted": False, "reason": reason,
                                 "estimate_s": round(est, 6),
                                 "replica": view.tag}
-        self._enqueue_request(fleet, body, rid, prompt, max_new,
-                              deadline_s, target=view.tag)
+        with rec.span("enqueue", parent=route_ctx,
+                      args={"rid": rid, "target": view.tag}) as sp:
+            self._enqueue_request(fleet, body, rid, prompt, max_new,
+                                  deadline_s, target=view.tag, tc=sp.ctx)
         if depth > 0:
             self.stats.routed_prefix += 1
         else:
@@ -385,14 +401,15 @@ class Gateway:
     def _enqueue_request(self, fleet: _FleetState, body: dict, rid: str,
                          prompt: list[int], max_new: int,
                          deadline_s: float | None,
-                         target: str | None) -> None:
+                         target: str | None, tc=None) -> None:
         write_request(
             fleet.kv, rid, prompt, max_new,
             deadline_unix=None if deadline_s is None
             else time.time() + deadline_s,
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
-            seed=int(body.get("seed", 0)))
+            seed=int(body.get("seed", 0)),
+            tc=None if tc is None else tc.to_wire())
         if target is None:
             enqueue(fleet.kv, rid)
         else:
@@ -407,6 +424,7 @@ class Gateway:
         door shed racing a retry's fresh execution still yields exactly
         one terminal verdict per rid."""
         self.stats.shed_door += 1
+        get_registry().counter(f"gateway.shed.door.{reason}").inc()
         if fleet.kv.add(k_done(rid)) == 1:
             fleet.kv.set(k_result(rid), json.dumps({
                 "rid": rid, "verdict": "SHED", "reason": f"door:{reason}",
@@ -486,6 +504,22 @@ class Gateway:
             }
         return {"stats": dict(self.stats.__dict__), "fleets": fleets,
                 "admission": self.admission}
+
+    def _metrics_body(self) -> dict:
+        """The OP_METRICS scrape: this process's registry snapshot and
+        recorder stats, plus each replica's recorder stats as last seen
+        riding its TTL'd load report — one scrape sees whether ANY
+        process in the fleet is silently dropping trace events."""
+        replica_recorders = {}
+        for name, fleet in self._fleets.items():
+            self._refresh(fleet)
+            for tag, entry in sorted(fleet.replicas.items()):
+                stats = entry.report.get("recorder")
+                if stats is not None:
+                    replica_recorders[f"{name or 'default'}/{tag}"] = stats
+        return {"registry": get_registry().snapshot(),
+                "recorder": get_recorder().stats(),
+                "replica_recorders": replica_recorders}
 
 
 # -- gateway process main -----------------------------------------------------

@@ -23,6 +23,8 @@ self-describing body beats the kvstore's key/val split). Ops:
     'C' clear   — delete a SHED verdict + its claim marker so a retry's
                   fresh execution can publish (the client retry path).
     'L' stats   — gateway + per-fleet routing-table introspection.
+    'M' metrics — live scrape of the obs metrics registry plus recorder
+                  stats (gateway-local and per-replica via load reports).
 
 Any protocol violation — oversized or truncated frame, undecodable JSON,
 unknown op, auth failure — closes the connection; it never wedges the
@@ -51,9 +53,10 @@ OP_TRY = ord("T")
 OP_HEDGE = ord("E")
 OP_CLEAR = ord("C")
 OP_STATS = ord("L")
+OP_METRICS = ord("M")
 
 KNOWN_OPS = frozenset({OP_HELLO, OP_SUBMIT, OP_WAIT, OP_TRY, OP_HEDGE,
-                       OP_CLEAR, OP_STATS})
+                       OP_CLEAR, OP_STATS, OP_METRICS})
 
 ST_OK = 0
 ST_ERR = 1

@@ -46,8 +46,21 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from tpu_sandbox.obs import get_recorder, get_registry
+
 SLOT_PREFIX = "mpmd/slot"
 CLAIM_PREFIX = "mpmd/claim"
+
+
+def _account(stats: TransportStats) -> None:
+    """Mirror per-transport stats into the process metrics registry so a
+    live OP_METRICS scrape sees wire traffic without reaching into every
+    Transport instance."""
+    reg = get_registry()
+    reg.gauge("transport.puts").set(stats.puts)
+    reg.gauge("transport.gets").set(stats.gets)
+    reg.gauge("transport.bytes_out").set(stats.bytes_out)
+    reg.gauge("transport.bytes_in").set(stats.bytes_in)
 
 
 def pack_arrays(arrays) -> tuple[dict, bytes]:
@@ -156,6 +169,10 @@ class LocalTransport(Transport):
         self.stats.puts += 1
         self.stats.bytes_out += len(payload)
         self.stats.put_seconds += time.perf_counter() - t0
+        _account(self.stats)
+        get_recorder().instant(
+            "slot:put", args={"edge": edge, "step": step, "mb": mb,
+                              "bytes": len(payload), "first": first})
         return first
 
     def get(self, edge, step, mb, *, timeout: float = 60.0):
@@ -174,6 +191,7 @@ class LocalTransport(Transport):
         self.stats.gets += 1
         self.stats.bytes_in += len(payload)
         self.stats.get_seconds += time.perf_counter() - t0
+        _account(self.stats)
         return out
 
     def poll(self, edge, step, mb) -> bool:
@@ -184,7 +202,12 @@ class LocalTransport(Transport):
         key = (edge, step, mb, generation)
         with self._cond:
             self._claims[key] = self._claims.get(key, 0) + 1
-            return self._claims[key] == 1
+            won = self._claims[key] == 1
+        if won:
+            get_recorder().instant(
+                "slot:claim", args={"edge": edge, "step": step, "mb": mb,
+                                    "gen": generation})
+        return won
 
     def release_step(self, edge, step) -> None:
         with self._cond:
@@ -259,6 +282,10 @@ class KVTransport(Transport):
         self.stats.puts += 1
         self.stats.bytes_out += len(payload)
         self.stats.put_seconds += time.perf_counter() - t0
+        _account(self.stats)
+        get_recorder().instant(
+            "slot:put", args={"edge": edge, "step": step, "mb": mb,
+                              "bytes": len(payload), "first": first})
         return first
 
     def get(self, edge, step, mb, *, timeout: float = 60.0):
@@ -291,6 +318,7 @@ class KVTransport(Transport):
         self.stats.gets += 1
         self.stats.bytes_in += len(payload)
         self.stats.get_seconds += time.perf_counter() - t0
+        _account(self.stats)
         return out
 
     def poll(self, edge, step, mb) -> bool:
@@ -304,6 +332,9 @@ class KVTransport(Transport):
             # dead generation's claims expire (value no longer needs to
             # count past "claimed at least twice" for the audit)
             self.kv.set_ttl(key, str(n), self.claim_ttl)
+            get_recorder().instant(
+                "slot:claim", args={"edge": edge, "step": step, "mb": mb,
+                                    "gen": generation})
         return n == 1
 
     def release_step(self, edge, step) -> None:

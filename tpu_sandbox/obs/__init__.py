@@ -1,0 +1,30 @@
+"""Flight recorder: causal tracing, fleet metrics, merged timelines.
+
+Three pieces, deliberately decoupled:
+
+- :mod:`tpu_sandbox.obs.record` — the in-process recorder. Append-only
+  per-process JSONL, monotonic timestamps, propagated trace context.
+  Off by default; exporting ``TPU_SANDBOX_TRACE_DIR`` turns it on for
+  every process that inherits the env (agents, replicas, the gateway).
+- :mod:`tpu_sandbox.obs.metrics` — counters / gauges / streaming-quantile
+  histograms. Always on (an increment is nanoseconds); scraped live via
+  the gateway's METRICS wire op.
+- :mod:`tpu_sandbox.obs.collect` — the offline collector: merges per-host
+  logs on a KV-sequencer-calibrated clock, emits Chrome trace-event JSON,
+  per-request waterfalls, and last-N-seconds postmortem timelines
+  (``tools/tracecat.py`` is the CLI).
+"""
+
+from tpu_sandbox.obs.record import (ENV_TRACE_DIR, Recorder, TraceContext,
+                                    get_recorder, reset_recorder)
+from tpu_sandbox.obs.metrics import MetricsRegistry, get_registry
+
+__all__ = [
+    "ENV_TRACE_DIR",
+    "MetricsRegistry",
+    "Recorder",
+    "TraceContext",
+    "get_recorder",
+    "get_registry",
+    "reset_recorder",
+]

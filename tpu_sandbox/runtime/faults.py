@@ -264,6 +264,12 @@ class FaultInjector:
         return fired
 
     def _fire(self, f: Fault) -> None:
+        # announce BEFORE executing: instants flush to disk, so even a
+        # self-SIGKILL on the next line leaves its mark on the timeline
+        from tpu_sandbox.obs import get_recorder
+
+        get_recorder().instant(f"fault:{f.action}",
+                               args={"rank": self.rank, "step": f.step})
         if f.action in ("kill", "kill_during_commit"):
             os.kill(os.getpid(), signal.SIGKILL)
         elif f.action == "sigterm":

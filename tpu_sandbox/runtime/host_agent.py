@@ -59,6 +59,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Mapping, Sequence
 
+from tpu_sandbox.obs import get_recorder
 from tpu_sandbox.runtime.election import LeaseElection
 from tpu_sandbox.runtime.faults import agent_cmd_key
 from tpu_sandbox.runtime.kvstore import (
@@ -343,9 +344,17 @@ class HostAgent:
         if action == "kill_agent":
             self._log("fault: kill_agent — dying uncleanly (SIGKILL self; "
                       "pdeathsig takes the local ranks with us)")
+            # last words for the postmortem: instants flush to disk, so
+            # the merged timeline shows the kill even though nothing of
+            # this process survives the next line
+            rec = get_recorder()
+            rec.instant("fault:kill_agent", args={"agent": self.aid})
+            rec.flush()
             os.kill(os.getpid(), signal.SIGKILL)
         elif action == "partition_host":
             dur = float(cmd.get("arg") or 5.0)
+            get_recorder().instant("fault:partition_host",
+                                   args={"agent": self.aid, "duration": dur})
             self._log(
                 f"fault: partition_host — silent toward the KV store for "
                 f"{dur:.1f}s (local ranks keep running)"
@@ -543,10 +552,12 @@ class HostAgent:
         self.kv.delete_prefix("ckpt/")
 
     def _advance_generation(self, gen: int) -> None:
+        get_recorder().instant("generation:advance", args={"gen": gen})
         self.kv.set(K_GENERATION, str(gen))
         self._publish_generation(gen)
 
     def _publish_generation(self, gen: int) -> None:
+        get_recorder().instant("generation:publish", args={"gen": gen})
         st = self._leader_state
         self._reset_health_plane()
         self.kv.delete(k_coordinator(gen))

@@ -57,6 +57,7 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Mapping
 
+from tpu_sandbox.obs import get_recorder, get_registry
 from tpu_sandbox.runtime.host_agent import (
     K_JOB_DONE,
     _agent_hb_key,
@@ -195,6 +196,7 @@ def submit_job(kv: KVClient, spec: JobSpec) -> int:
     kv.set(k_seq(spec.job_id), str(seq))
     kv.set(k_state(spec.job_id), QUEUED)
     kv.set(k_event(spec.job_id, "submitted"), f"{time.time():.6f}")
+    get_recorder().instant("job:submitted", args={"job": spec.job_id})
     return seq
 
 
@@ -524,7 +526,7 @@ class ClusterScheduler:
             jkv.delete(K_JOB_DONE)
             self.kv.delete(k_state(job_id))
             self.kv.set(k_state(job_id), QUEUED)
-            self.kv.set(k_event(job_id, "preempted"), f"{time.time():.6f}")
+            self._stamp_event(job_id, "preempted")
             self._log(f"job {job_id!r}: preempted cleanly; re-queued "
                       f"(seq {job.seq}) for resume")
             return
@@ -623,8 +625,7 @@ class ClusterScheduler:
             self._finish_job(job_id, "cancelled", verdict=None)
         else:
             self.kv.set(k_state(job_id), QUEUED)
-            self.kv.set(k_event(job_id, "preempt_killed"),
-                        f"{time.time():.6f}")
+            self._stamp_event(job_id, "preempt_killed")
             self._log(f"job {job_id!r}: re-queued after hard kill (its "
                       "restart budget will charge the unclean stop)")
 
@@ -648,6 +649,7 @@ class ClusterScheduler:
                 # durable ledger: a successor scheduler resumes the
                 # 2:1 convergence instead of resetting every debt
                 self.kv.set(f"{K_VTIME_PREFIX}{tenant}", repr(vt))
+                get_registry().gauge(f"sched.vtime.{tenant}").set(vt)
 
     def tenant_vtime(self, tenant: str) -> float:
         return self._tenant_vtime.get(tenant, 0.0)
@@ -705,8 +707,7 @@ class ClusterScheduler:
             for victim in victims:
                 victim.preempting = True
                 self.kv.set(k_state(victim.spec.job_id), PREEMPTING)
-                self.kv.set(k_event(victim.spec.job_id, "preempt_sent"),
-                            f"{time.time():.6f}")
+                self._stamp_event(victim.spec.job_id, "preempt_sent")
                 self._log(
                     f"preempting job {victim.spec.job_id!r} (priority "
                     f"{victim.spec.priority}) to admit "
@@ -772,8 +773,7 @@ class ClusterScheduler:
                 continue  # the head's own co-gang never backfills itself
             if cand.hosts > free:
                 continue
-            self.kv.set(k_event(cand.job_id, "backfilled"),
-                        f"{time.time():.6f}")
+            self._stamp_event(cand.job_id, "backfilled")
             self._log(
                 f"backfilling job {cand.job_id!r} (priority "
                 f"{cand.priority}, {cand.hosts} host(s)) behind blocked "
@@ -845,11 +845,18 @@ class ClusterScheduler:
         self.kv.set(k_state(spec.job_id), RUNNING)
         resumed = self.kv.try_get(k_event(spec.job_id, "admitted"))
         name = "admitted" if resumed is None else "readmitted"
-        self.kv.set(k_event(spec.job_id, name), f"{time.time():.6f}")
+        self._stamp_event(spec.job_id, name)
         self._log(
             f"job {spec.job_id!r}: {name} — gang of {spec.hosts} host(s), "
             f"world {spec.world_size}, priority {spec.priority}"
         )
+
+    def _stamp_event(self, job_id: str, name: str) -> None:
+        """One job-lifecycle stamp, twice: the durable wall-clock KV key
+        (bench receipts, resume detection) and a flight-recorder instant
+        (the merged timeline)."""
+        self.kv.set(k_event(job_id, name), f"{time.time():.6f}")
+        get_recorder().instant(f"job:{name}", args={"job": job_id})
 
     # -- terminal bookkeeping ----------------------------------------------
 
@@ -870,5 +877,5 @@ class ClusterScheduler:
         if verdict is not None:
             self.kv.set(k_verdict(job_id), json.dumps(verdict))
         self.kv.set(k_state(job_id), state)
-        self.kv.set(k_event(job_id, state), f"{time.time():.6f}")
+        self._stamp_event(job_id, state)
         self._log(f"job {job_id!r}: {state}")

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_sandbox.models.transformer import TransformerConfig
+from tpu_sandbox.obs import get_recorder, get_registry
 from tpu_sandbox.serve.cache import CacheConfig, PagedKVCache, SeqAlloc
 from tpu_sandbox.serve.decode import (DecodeStep, build_decode_step,
                                       init_pages, sample_token)
@@ -85,6 +86,8 @@ class Request:
     temperature: float = 0.0       # 0 -> greedy argmax
     top_k: int = 0                 # 0 -> full vocab
     seed: int = 0                  # sampler key; folded with the step index
+    tc: dict | None = None         # trace context (wire form); never affects
+                                   # tokens, only the flight recorder
 
 
 @dataclass
@@ -95,6 +98,7 @@ class RequestResult:
     itl: list[float]              # inter-token latencies (s)
     finished_at: float = 0.0
     preemptions: int = 0
+    tc: dict | None = None        # decode span context; parents the verdict
 
 
 @dataclass
@@ -105,6 +109,7 @@ class ShedRecord:
     reason: str       # "queue_full" | "deadline" | explicit shed reason
     shed_at: float
     preemptions: int = 0
+    tc: dict | None = None  # shed-instant context; parents the verdict
 
 
 @dataclass
@@ -117,6 +122,9 @@ class _Slot:
     last_token_at: float | None = None
     itl: list[float] = field(default_factory=list)
     preemptions: int = 0
+    tc: dict | None = None            # admit span context
+    admitted_mono: float | None = None  # real monotonic time of admission
+                                        # (the engine clock may be a fake)
 
 
 class _EngineBase:
@@ -192,11 +200,20 @@ class _EngineBase:
     # -- SLO guardrails ------------------------------------------------------
 
     def _record_shed(self, request: Request, reason: str,
-                     preemptions: int | None = None) -> None:
+                     preemptions: int | None = None,
+                     tc: dict | None = None) -> None:
+        # the shed instant is the trace's terminal node for this request;
+        # its context rides the ShedRecord so the replica's verdict
+        # instant stays chained
+        ctx = get_recorder().instant(
+            f"shed:{reason}", parent=tc if tc is not None else request.tc,
+            args={"rid": request.rid})
+        get_registry().counter(f"engine.shed.{reason}").inc()
         self.shed[request.rid] = ShedRecord(
             rid=request.rid, reason=reason, shed_at=self.clock(),
             preemptions=request.preemptions if preemptions is None
-            else preemptions)
+            else preemptions,
+            tc=None if ctx is None else ctx.to_wire())
 
     def shed_expired(self) -> int:
         """Shed every waiting or active request whose deadline has passed,
@@ -252,6 +269,7 @@ class _EngineBase:
             "shed": len(self.shed),
             "done": len(self.results),
             "prefix_digest": cache.resident_prefix_digest(),
+            "recorder": get_recorder().stats(),
         }
 
     # -- shared mechanics ----------------------------------------------------
@@ -271,6 +289,7 @@ class _EngineBase:
 
     def _prefill(self, request: Request, alloc: SeqAlloc, slot_idx: int):
         cfg = self.config
+        t_admit = time.monotonic()
         plen = len(request.prompt)
         bucket = self.step_fns.pick_bucket(plen)
         toks = np.zeros((1, bucket), np.int32)
@@ -284,6 +303,12 @@ class _EngineBase:
         self.cache.commit_prefix(alloc)
         slot = _Slot(request=request, alloc=alloc, tokens=list(request.prompt),
                      preemptions=request.preemptions)
+        # the admit span covers the prefill compute; the decode span that
+        # follows is emitted retrospectively at retire time, anchored here
+        ctx = get_recorder().complete("admit", t_admit, parent=request.tc,
+                                      args={"rid": request.rid})
+        slot.tc = None if ctx is None else ctx.to_wire()
+        slot.admitted_mono = time.monotonic()
         self.slots[slot_idx] = slot
         self._emit_token(slot, self._pick_token(slot, np.asarray(next_logits)))
         if self._finished(slot):
@@ -323,16 +348,24 @@ class _EngineBase:
         self.slots[i] = None
         self.cache.free(slot.alloc)
         req = slot.request
+        ctx = get_recorder().complete(
+            "decode",
+            slot.admitted_mono if slot.admitted_mono is not None
+            else time.monotonic(),
+            parent=slot.tc,
+            args={"rid": req.rid, "tokens": len(slot.generated)})
+        tc = None if ctx is None else ctx.to_wire()
         if req.deadline is not None and self.clock() > req.deadline:
             # finished, but past the promise: the verdict is SHED, never a
             # late result
-            self._record_shed(req, "deadline", preemptions=slot.preemptions)
+            self._record_shed(req, "deadline", preemptions=slot.preemptions,
+                              tc=tc)
             return
         self.results[req.rid] = RequestResult(
             rid=req.rid, tokens=list(slot.generated),
             ttft=slot.first_token_at - req.arrival,
             itl=list(slot.itl), finished_at=self.clock(),
-            preemptions=slot.preemptions)
+            preemptions=slot.preemptions, tc=tc)
 
     def _preempt(self, i: int) -> None:
         """Evict slot i back to the waiting queue (front: it has seniority)."""

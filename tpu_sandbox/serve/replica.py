@@ -61,6 +61,7 @@ import time
 from dataclasses import dataclass
 from typing import Sequence
 
+from tpu_sandbox.obs import get_recorder
 from tpu_sandbox.runtime.kvstore import KVClient
 from tpu_sandbox.runtime.supervisor import ENV_KV_PORT, PREEMPTED_EXIT_CODE
 
@@ -126,12 +127,16 @@ def k_tq_scavenged(tag: str, seq: int) -> str:
 def write_request(kv, rid: str, prompt: Sequence[int],
                   max_new_tokens: int, *, deadline_unix: float | None = None,
                   temperature: float = 0.0, top_k: int = 0,
-                  seed: int = 0) -> None:
+                  seed: int = 0, tc: dict | None = None) -> None:
     """Write the request body without enqueueing — the gateway writes the
     body once, then targets the entry at the replica routing chose.
     ``deadline_unix`` is wall clock (``time.time()``) so it survives the
     hop between client and replica processes; replicas translate it to
-    their engine clock at claim time."""
+    their engine clock at claim time. ``tc`` is the submit trace context
+    (``TraceContext.to_wire()``); it rides the body so the claim span can
+    chain to the gateway's enqueue span. The body is written exactly once
+    per rid either way, so adding the key never perturbs the
+    idempotent-verdict contract."""
     body = {"rid": rid, "prompt": list(map(int, prompt)),
             "max_new_tokens": int(max_new_tokens)}
     if deadline_unix is not None:
@@ -139,6 +144,8 @@ def write_request(kv, rid: str, prompt: Sequence[int],
     if temperature > 0.0:
         body.update(temperature=float(temperature), top_k=int(top_k),
                     seed=int(seed))
+    if tc is not None:
+        body["tc"] = tc
     kv.set(k_req(rid), json.dumps(body))
 
 
@@ -318,6 +325,7 @@ class ReplicaWorker:
         resulted, or lost the claim race."""
         if rid_raw is None:
             return False
+        t_claim = time.monotonic()
         rid = rid_raw.decode()
         if self.kv.try_get(k_result(rid)) is not None:
             return False
@@ -333,7 +341,13 @@ class ReplicaWorker:
         # published, so the fresh execution's verdict goes out too
         # (the claim-once serve/done marker still arbitrates races).
         self._published.discard(rid)
-        self.engine.submit(self._to_request(body))
+        req = self._to_request(body)
+        ctx = get_recorder().complete(
+            "claim", t_claim, parent=body.get("tc"),
+            args={"rid": rid, "replica": self.tag})
+        if ctx is not None:
+            req.tc = ctx.to_wire()
+        self.engine.submit(req)
         self.stats.claimed += 1
         return True
 
@@ -432,7 +446,14 @@ class ReplicaWorker:
                 continue  # someone is alive and working it
             if self.kv.add(k_scavenged(n)) != 1:
                 continue  # another scavenger took this entry
+            # exactly one scavenger reaches here per entry, so these
+            # instants appear once on the merged timeline per rescue
+            get_recorder().instant("lease:expired",
+                                   args={"rid": rid, "entry": n})
             enqueue(self.kv, rid)
+            get_recorder().instant(
+                "scavenge:requeue",
+                args={"rid": rid, "entry": n, "by": self.tag})
             n_rescued += 1
         for tag in targeted_tags(self.kv):
             owner_alive = tag == self.tag \
@@ -454,11 +475,18 @@ class ReplicaWorker:
                     continue  # our own backlog: tick claims it, not scavenge
                 if self.kv.add(k_tq_scavenged(tag, n)) != 1:
                     continue
+                get_recorder().instant(
+                    "lease:expired",
+                    args={"rid": rid, "entry": n, "owner": tag})
                 # claim the original too, so a resurrected owner does not
                 # re-execute it (racy owners only waste compute; verdict
                 # publication stays claim-once either way)
                 self.kv.add(k_tq_claim(tag, n))
                 enqueue(self.kv, rid)
+                get_recorder().instant(
+                    "scavenge:requeue",
+                    args={"rid": rid, "entry": n, "owner": tag,
+                          "by": self.tag})
                 n_rescued += 1
         self.stats.scavenged += n_rescued
         return n_rescued
@@ -479,6 +507,11 @@ class ReplicaWorker:
         for rid, res in self.engine.results.items():
             if rid in self._published:
                 continue
+            # the verdict INSTANT is trace-only; the verdict BODY below is
+            # untouched, so bitwise-identical republication still holds
+            get_recorder().instant(
+                "verdict", parent=getattr(res, "tc", None),
+                args={"rid": rid, "verdict": "ok"})
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "ok", "tokens": res.tokens,
                 "preemptions": res.preemptions, "replica": self.tag,
@@ -487,6 +520,9 @@ class ReplicaWorker:
         for rid, rec in self.engine.shed.items():
             if rid in self._published:
                 continue
+            get_recorder().instant(
+                "verdict", parent=getattr(rec, "tc", None),
+                args={"rid": rid, "verdict": "SHED"})
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "SHED", "reason": rec.reason,
                 "preemptions": rec.preemptions, "replica": self.tag})

@@ -20,6 +20,7 @@ TPU-first differences:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 import time
@@ -33,8 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tpu_sandbox.obs import get_recorder
 from tpu_sandbox.ops.losses import cross_entropy_loss
 from tpu_sandbox.train.state import TrainState
+from tpu_sandbox.utils.metrics import MetricsWriter
 
 #: Exit code the supervisor treats as "preempted: saved, restart for free".
 #: Canonical home is runtime/supervisor.py; mirrored here so the training
@@ -268,7 +271,8 @@ class Trainer:
             )
 
     def fit(self, state: TrainState, loader, epochs: int, *,
-            set_epoch: bool = False, prefetch: bool = False):
+            set_epoch: bool = False, prefetch: bool = False,
+            metrics_path: str | None = None):
         """Run ``epochs`` epochs. ``set_epoch=False`` reproduces the
         reference quirk of never reshuffling the sharded data
         (no ``sampler.set_epoch``, SURVEY §2.1 C14).
@@ -276,14 +280,20 @@ class Trainer:
         ``prefetch=True`` wraps the loader in a
         :class:`~tpu_sandbox.data.loader.PrefetchLoader` (double-buffered
         background batch assembly) unless it already is one — same batches
-        in the same order, assembled while the previous step runs."""
+        in the same order, assembled while the previous step runs.
+
+        ``metrics_path`` writes a JSONL metrics record per log event; the
+        writer's lifetime is the fit call (context-managed, so the fd
+        closes on every exit path, raising included)."""
         loader = _maybe_prefetch(loader, prefetch)
         start = time.monotonic()
         total_step = len(loader)
         opt_step = int(jax.numpy.ravel(state.step)[0])  # resume-safe seed
         try:
-            state = self._run_epochs(state, loader, epochs, set_epoch,
-                                     total_step, opt_step)
+            with (MetricsWriter(metrics_path) if metrics_path
+                  else contextlib.nullcontext()) as mw:
+                state = self._run_epochs(state, loader, epochs, set_epoch,
+                                         total_step, opt_step, mw=mw)
         finally:
             if self._saver is not None:
                 # drain in-flight async writes even when the loop raised —
@@ -298,12 +308,15 @@ class Trainer:
         return state
 
     def _run_epochs(self, state, loader, epochs, set_epoch, total_step,
-                    opt_step):
+                    opt_step, mw=None):
         for epoch in range(epochs):
             if set_epoch:
                 loader.set_epoch(epoch)
             for i, (images, labels) in enumerate(loader):
+                t_step = time.monotonic()
                 state, loss = self.train_step(state, images, labels)
+                get_recorder().complete("train:step", t_step,
+                                        args={"step": opt_step + 1})
                 opt_step += 1
                 self._maybe_checkpoint(state, opt_step)
                 if (i + 1) % self.log_every == 0:
@@ -320,6 +333,8 @@ class Trainer:
                         loss_host = loss
                     loss_val = float(jax.numpy.ravel(loss_host)[0])
                     self.losses.append(loss_val)
+                    if mw is not None:
+                        mw.write(opt_step, loss=loss_val, epoch=epoch + 1)
                     if self.verbose:
                         if self.log_rank is not None:
                             print(
