@@ -1792,6 +1792,305 @@ def bench_obs(*, quick: bool = False, seed: int = 0) -> dict:
     }
 
 
+def bench_health(*, quick: bool = False, seed: int = 0) -> dict:
+    """Health-plane receipts: is the durable metrics plane cheap enough
+    to leave ON, and does it catch the pathologies fast enough to act?
+
+    Three measurements, all chipless:
+
+    1. **Flush overhead** — per-flush wall cost of a replica-sized
+       registry (counters/gauges/histograms with label variants) into a
+       live KV, plus paired step-loop arms flushing on the production
+       cadence (once per tsdb bucket). The claim: <= 1% of step time.
+    2. **Detection latency** — a stub-clock ``HealthMonitor`` against
+       each seeded pathology (autoscale flapping, tenant starvation,
+       preemption cascade): evaluation windows from pathology visible to
+       alert claimed. The claim: <= 2 windows each.
+    3. **fleetop** — the ops console renders from a live 2-replica
+       modeled fleet (real sockets/KV/engine, sleep-modeled step) whose
+       time series came off the replicas' own load-report cadence.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import statistics
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_sandbox.gateway import FleetSpec, Gateway, GatewayClient
+    from tpu_sandbox.models.transformer import TransformerConfig
+    from tpu_sandbox.obs.health import (CascadeDetector, HealthMonitor,
+                                        OscillationDetector,
+                                        StarvationDetector)
+    from tpu_sandbox.obs.metrics import MetricsRegistry
+    from tpu_sandbox.obs.record import Recorder
+    from tpu_sandbox.obs.tsdb import TimeSeriesFlusher, list_series
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+    from tpu_sandbox.serve.cache import CacheConfig
+    from tpu_sandbox.serve.engine import ContinuousEngine, ServeConfig
+    from tpu_sandbox.serve.replica import ReplicaWorker
+
+    # -- 1. flush overhead ---------------------------------------------------
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    try:
+        reg = MetricsRegistry()
+        for i in range(12):
+            reg.counter(f"bench.counter.c{i}",
+                        labels={"kind": str(i % 3)}).inc(i)
+        for i in range(6):
+            reg.gauge(f"bench.gauge.g{i}").set(float(i))
+        h = reg.histogram("bench.lat.s")
+        for v in range(256):
+            h.observe(v / 256.0)
+        bucket_s = 1.0
+        flusher = TimeSeriesFlusher(kv, "bench-rep", bucket_s=bucket_s,
+                                    registry=reg, recorder=Recorder(None))
+        keys_per_flush = flusher.flush()  # warm (first flush writes all)
+        n_flush = 20 if quick else 60
+        flush_times = []
+        for i in range(n_flush):
+            reg.counter("bench.counter.c0", labels={"kind": "0"}).inc()
+            t0 = time.monotonic()
+            flusher.flush()
+            flush_times.append(time.monotonic() - t0)
+        flush_ms = statistics.median(flush_times) * 1e3
+        # the production cadence is one flush per bucket: the fraction of
+        # every bucket interval spent flushing IS the step-time overhead
+        flush_frac = flush_ms / (bucket_s * 1e3)
+
+        # paired corroboration: identical step loops, the on arm also
+        # flushing whenever the bucket rolls over
+        x = jnp.ones((512, 512), jnp.float32)
+        step = jax.jit(lambda a: a @ a / 512.0)
+        step(x).block_until_ready()
+        t0 = time.monotonic()
+        for _ in range(50):
+            step(x).block_until_ready()
+        step_ms = (time.monotonic() - t0) / 50 * 1e3
+        loop_s = 0.4 if quick else 1.0
+        n_steps = max(50, int(loop_s / (step_ms / 1e3)))
+        rounds = 3 if quick else 6
+
+        def run_loop(flush_bucket_s=None):
+            nxt = time.monotonic() + (flush_bucket_s or 1e9)
+            t0 = time.monotonic()
+            for _ in range(n_steps):
+                step(x).block_until_ready()
+                if time.monotonic() >= nxt:
+                    reg.counter("bench.counter.c1",
+                                labels={"kind": "1"}).inc()
+                    flusher.flush()
+                    nxt += flush_bucket_s
+            return time.monotonic() - t0
+
+        run_loop()  # warm the loop shape
+        paired = []
+        for _ in range(rounds):
+            off = run_loop()
+            on = run_loop(flush_bucket_s=bucket_s)
+            paired.append((on - off) / off)
+        paired_delta = statistics.median(paired)
+    finally:
+        kv.close()
+        server.stop()
+
+    # -- 2. detection latency (stub clock) -----------------------------------
+    def _windows_to_alert(seed_pathology, detector, setup=None):
+        """Evaluation windows from 'pathology visible in durable state'
+        to 'alert claimed', on a monitor stepped once per window.
+        ``setup`` seeds the healthy pre-pathology state the baseline
+        evaluation observes."""
+        srv = KVServer()
+        dkv = KVClient(port=srv.port)
+        try:
+            t = [9000.0]
+            mon = HealthMonitor(dkv, "bench-h0", window_s=1.0, rules=[],
+                                detectors=[detector],
+                                clock=lambda: t[0])
+            if setup is not None:
+                setup(dkv)
+            mon.step()  # baseline evaluation before the pathology
+            windows = 0
+            while windows < 8:
+                seed_pathology(dkv, windows)
+                t[0] += 1.0
+                windows += 1
+                if mon.step():
+                    return windows
+            return None
+        finally:
+            dkv.close()
+            srv.stop()
+
+    def seed_flapping(dkv, i):
+        if i > 0:
+            return
+        tail = 0
+        for action in ("scale_up", "scale_down") * 2:
+            dkv.set(f"serve/autoscale/events/{tail}", json.dumps(
+                {"action": action, "reason": "queue_depth", "wall": 0.0}))
+            tail += 1
+        dkv.set("serve/autoscale/tail", str(tail))
+
+    def setup_tenants(dkv):
+        # both tenants known (and the mouse already queued) before onset
+        dkv.set("sched/vtime/hog", repr(0.0))
+        dkv.set("sched/vtime/mouse", repr(0.0))
+        dkv.set("sched/queued/mouse", "2")
+
+    def seed_starvation(dkv, i):
+        # onset: the hog's vtime advances every window, the mouse's not
+        dkv.set("sched/vtime/hog", repr(10.0 * (i + 1)))
+
+    def seed_cascade(dkv, i):
+        if i == 0:
+            for _ in range(3):
+                dkv.add("sched/preempts/victim")
+
+    latencies = {
+        "autoscale_oscillation": _windows_to_alert(
+            seed_flapping, OscillationDetector()),
+        "tenant_starvation": _windows_to_alert(
+            seed_starvation, StarvationDetector(), setup=setup_tenants),
+        "preemption_cascade": _windows_to_alert(
+            seed_cascade, CascadeDetector()),
+    }
+
+    # -- 3. fleetop renders from a live fleet --------------------------------
+    BLOCK = 8
+    mcfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=128)
+    ccfg = CacheConfig(num_blocks=48, block_size=BLOCK, max_blocks_per_seq=8)
+    rng = np.random.default_rng(seed)
+
+    class _ModeledStep:
+        buckets = (32,)
+        vocab = 64
+
+        def __init__(self):
+            self.prefill = {b: self._prefill for b in self.buckets}
+
+        def pick_bucket(self, plen):
+            for b in self.buckets:
+                if plen <= b:
+                    return b
+            raise ValueError(f"prompt of {plen} exceeds {self.buckets}")
+
+        def _prefill(self, params, k, v, toks, dest, last):
+            time.sleep(1e-3)
+            toks = np.asarray(toks)
+            logits = np.zeros((self.vocab,), np.float32)
+            logits[(int(toks[0, int(last)]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+        def decode(self, params, k, v, tokens, lengths, tables):
+            time.sleep(5e-4)
+            tokens = np.asarray(tokens)
+            logits = np.zeros((tokens.shape[0], self.vocab), np.float32)
+            for i in range(tokens.shape[0]):
+                logits[i, (int(tokens[i, 0]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    stop = threading.Event()
+    workers, threads, clones = [], [], []
+    gw = client = None
+    try:
+        for i in range(2):
+            wkv = kv.clone()
+            clones.append(wkv)
+            eng = ContinuousEngine(
+                None,
+                ServeConfig(model=mcfg, cache=ccfg, max_batch=4,
+                            buckets=_ModeledStep.buckets, max_waiting=0),
+                step=_ModeledStep())
+            w = ReplicaWorker(wkv, eng, tag=f"hw{i}", lease_ttl=1.0,
+                              load_interval=0.05)
+            workers.append(w)
+
+            def loop(worker=w):
+                while not stop.is_set():
+                    worker.tick()
+                    if worker.engine.idle:
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name=f"health-replica-hw{i}")
+            threads.append(t)
+            t.start()
+        gw = Gateway(kv, [FleetSpec(block_size=BLOCK)], admission="none",
+                     refresh_min_s=0.01, max_report_age_s=2.0).start()
+        client = GatewayClient(gw.port, max_retries=0)
+        time.sleep(0.2)
+        n_req = 6 if quick else 16
+        rids = []
+        for i in range(n_req):
+            prompt = [int(t) for t in rng.integers(1, 64, 2 * BLOCK)]
+            if client.submit(f"h{i}", prompt, 3):
+                rids.append(f"h{i}")
+        served = sum(1 for rid in rids
+                     if client.result(rid, timeout=60.0).get("verdict")
+                     == "ok")
+        time.sleep(0.2)  # one more load-report/flush cadence
+        mon = HealthMonitor(kv, "bench-live-h0", window_s=0.5)
+        mon.step()
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import fleetop
+        console = fleetop.render(kv)
+        n_series = len(list_series(kv))
+    finally:
+        if client is not None:
+            client.close()
+        if gw is not None:
+            gw.close()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for w in workers:
+            w.engine.drain_to_requests()
+        for c in clones:
+            c.close()
+        kv.close()
+        server.stop()
+
+    fleetop_ok = ("replicas:" in console and "hw0" in console
+                  and "hw1" in console and n_series > 0)
+    return {
+        "metric": "health",
+        "unit": "fractional overhead / evaluation windows",
+        "flush": {
+            "keys_per_flush": keys_per_flush,
+            "flush_ms": round(flush_ms, 4),
+            "bucket_s": bucket_s,
+            "overhead_frac": round(flush_frac, 5),
+            "paired_loop_delta_frac": round(paired_delta, 5),
+            "paired_rounds": rounds,
+            "steps_per_arm": n_steps,
+        },
+        "detection_windows": latencies,
+        "fleet": {
+            "replicas": 2,
+            "requests_served": served,
+            "live_series": n_series,
+            "fleetop_renders": bool(fleetop_ok),
+        },
+        "fleetop_sample": console.splitlines()[:24],
+        # the tentpole claims
+        "flush_overhead_ok": bool(flush_frac <= 0.01),
+        "detection_ok": bool(all(w is not None and w <= 2
+                                 for w in latencies.values())),
+        "fleetop_ok": bool(fleetop_ok),
+        "source": "measured wall time against a live KV store; detectors "
+                  "driven by a stub-clock monitor over seeded durable "
+                  "state; fleet modeled as in bench_obs (real "
+                  "sockets/queues/engine, sleep-modeled step)",
+    }
+
+
 def _measure_input_stall(n_batches: int = 30, load_ms: float = 10.0,
                          step_ms: float = 10.0) -> dict:
     """Measured wall-time of a sleep-modeled train loop with and without
@@ -2518,7 +2817,7 @@ def main():
     p.add_argument("--metric",
                    choices=["grad_compress", "overlap", "donation",
                             "cluster", "serve", "serve_slo", "gateway",
-                            "obs", "mpmd", "images_per_sec",
+                            "obs", "health", "mpmd", "images_per_sec",
                             "allreduce_bw", "pallas",
                             "capacity", "seq_scaling", "lm", "sweep",
                             "convergence"],
@@ -2577,6 +2876,10 @@ def main():
     if args.metric == "obs":
         # chipless flight-recorder overhead receipt; no probe
         print(json.dumps(bench_obs(quick=args.quick)))
+        return
+    if args.metric == "health":
+        # chipless health-plane overhead + detection-latency receipt
+        print(json.dumps(bench_health(quick=args.quick)))
         return
     if args.metric == "mpmd":
         # chipless MPMD-vs-SPMD pipeline receipt (CPU meshes + per-stage

@@ -1,0 +1,24 @@
+"""Seeded GL-O402 violations — dynamic / malformed metric names.
+
+Parsed by the lint tests, never imported. Each function mints series
+cardinality at runtime: the exact incident class the rule exists for
+(one dashboard per tenant id, one alert rule that matches nothing).
+"""
+
+from tpu_sandbox.obs import get_registry
+
+
+def fstring_name(tenant):
+    # one counter series per tenant id — unbounded cardinality
+    get_registry().counter(f"sched.tenant.{tenant}.queued").inc()
+
+
+def concatenated_name(stage):
+    reg = get_registry()
+    reg.gauge("pipeline." + stage).set(1.0)
+
+
+def undotted_name():
+    registry = get_registry()
+    # a static literal, but flat: no component prefix for rules to key on
+    registry.histogram("latency").observe(0.5)

@@ -125,6 +125,27 @@ def test_clean_obs_fixture_passes():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_bad_metrics_fixture_fires_gl_o402():
+    findings = lint_ctrl(_fixture("bad_metrics.py"), "bad_metrics.py")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # trips ONLY the metric-name rule — three spellings: f-string,
+    # concatenation, flat (undotted) literal
+    assert set(by_rule) == {"GL-O402"}
+    assert len(by_rule["GL-O402"]) == 3
+    msgs = "\n".join(f.message for f in by_rule["GL-O402"])
+    assert "counter()" in msgs
+    assert "gauge()" in msgs
+    assert "histogram()" in msgs
+    assert all(f.line > 0 and f.hint for f in findings)
+
+
+def test_clean_metrics_fixture_passes():
+    findings = lint_ctrl(_fixture("clean_metrics.py"), "clean_metrics.py")
+    assert findings == [], [f.format() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # Pass 2 fixtures (pure layers; the compile layer runs in the subprocess
 # gate below)

@@ -124,6 +124,23 @@ def test_metrics_registry_counters_gauges_histograms():
                               "histograms": {}}
 
 
+def test_metrics_registry_label_series_are_distinct_and_stable():
+    from tpu_sandbox.obs.metrics import series_key
+
+    assert series_key("engine.shed", None) == "engine.shed"
+    # label keys sort, so the same label SET is always the same series
+    assert series_key("engine.shed", {"reason": "deadline", "a": "b"}) == \
+        "engine.shed{a=b,reason=deadline}"
+    reg = MetricsRegistry()
+    reg.counter("engine.shed", labels={"reason": "deadline"}).inc()
+    reg.counter("engine.shed", labels={"reason": "door"}).inc(2)
+    reg.counter("engine.shed", labels={"reason": "deadline"}).inc()
+    snap = reg.snapshot()["counters"]
+    assert snap["engine.shed{reason=deadline}"] == 2
+    assert snap["engine.shed{reason=door}"] == 2
+    assert "engine.shed" not in snap  # the bare name was never minted
+
+
 # -- clock calibration / merge ------------------------------------------------
 
 
@@ -205,6 +222,58 @@ def test_chrome_trace_export_is_valid(tmp_path):
     assert spans[0]["args"]["trace"] == instants[0]["args"]["trace"]
 
 
+def test_clock_offsets_fall_back_to_preamble_without_calibration():
+    # headless run: nobody calibrated against the KV sequencer, so only
+    # the "P" preambles anchor each process's monotonic clock
+    logs = {
+        "a/1": [{"ph": "P", "mono": 10.0, "wall": 1010.0},
+                _span("first", 10.02, "T", "a.1")],
+        "b/2": [{"ph": "P", "mono": 20.0, "wall": 2020.0},
+                _span("second", 20.05, "T", "b.1", parent="a.1")],
+    }
+    offsets = collect.clock_offsets(logs)
+    assert offsets["a/1"] == pytest.approx(1000.0)
+    assert offsets["b/2"] == pytest.approx(2000.0)
+    merged = collect.merge(logs)
+    assert [r["name"] for r in merged] == ["first", "second"]
+
+
+def test_clock_offsets_median_rides_out_wall_clock_step():
+    # NTP steps the wall clock 100 s forward mid-run: the stepped
+    # calibration point is an outlier the median anchor must shrug off
+    logs = {
+        "a/1": [_cal(1, 10.0, 1010.0), _cal(2, 10.1, 1010.1),
+                _cal(3, 10.2, 1110.2)],
+    }
+    assert collect.clock_offsets(logs)["a/1"] == pytest.approx(1000.0)
+
+
+def test_clock_offsets_single_process_defaults_to_zero():
+    # no C and no P records at all (truncated log): offset 0.0, and the
+    # degenerate single-process merge still works
+    logs = {"solo/1": [_span("only", 5.0, "T", "s.1")]}
+    assert collect.clock_offsets(logs) == {"solo/1": 0.0}
+    assert collect.merge(logs)[0]["uts"] == pytest.approx(5.0)
+
+
+def test_metric_samples_round_trip_as_chrome_counter_tracks(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    rec = Recorder(path, proc="meter", flush_every=1)
+    rec.metric("sched.queue.depth", 3.0)
+    rec.metric("sched.queue.depth", 5.0)
+    rec.close()
+    merged = collect.merge(collect.load_dir(str(tmp_path)))
+    assert [r["value"] for r in merged if r["ph"] == "m"] == [3.0, 5.0]
+    doc = json.loads(json.dumps(collect.to_chrome_trace(merged)))
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert len(counters) == 2
+    assert all(c["name"] == "sched.queue.depth" for c in counters)
+    # Perfetto draws the track from args.value at each ts
+    assert [c["args"]["value"] for c in counters] == [3.0, 5.0]
+    assert counters[0]["ts"] <= counters[1]["ts"]
+    assert all(isinstance(c["args"]["value"], float) for c in counters)
+
+
 def test_last_window_measures_from_last_record_not_now():
     merged = [
         {"ph": "i", "name": "old", "uts": 100.0, "args": {}},
@@ -243,6 +312,9 @@ def test_gateway_metrics_scrape_over_socket(kv_pair, traced):
     assert "default/w0" in body["replica_recorders"]
     assert set(body["replica_recorders"]["default/w0"]) == \
         {"events", "dropped"}
+    # the fleet-wide drop total the recorder_drops health rule keys on
+    assert body["dropped_events"] == body["recorder"]["dropped"] + \
+        body["replica_recorders"]["default/w0"]["dropped"]
 
 
 # -- THE acceptance test: end-to-end trace completeness -----------------------

@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""fleetop — live ops console for the health plane.
+
+Connects to the cluster KV store and renders, from durable state alone
+(no process needs to cooperate):
+
+- fleet health gauges from the tsdb ring (queue depth, replica count,
+  goodput, recorder drops) and the per-series producer list;
+- per-replica occupancy and SLO burn: the TTL'd load reports next to
+  each replica's shed/done burn rate over the recent window, with
+  replicas currently excluded from routing (active ``replica_burn``)
+  flagged;
+- active alerts (the TTL'd condition flags control planes act on) and
+  the most recent durable alert records;
+- postmortem pointers: the ``tracecat`` invocation that reconstructs
+  the causal timeline around each recent alert.
+
+    python tools/fleetop.py --port 5999
+        One shot: render and exit.
+
+    python tools/fleetop.py --port 5999 --watch 2
+        Clear-screen refresh every 2 s until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_sandbox.obs import health, tsdb  # noqa: E402
+from tpu_sandbox.runtime.kvstore import KVClient  # noqa: E402
+from tpu_sandbox.serve.replica import read_load_reports  # noqa: E402
+
+#: fleet gauges worth a headline line when any process publishes them
+FLEET_GAUGES = (
+    "sched.queue.depth", "sched.running", "autoscale.replicas",
+    "serve.goodput", "obs.recorder.dropped",
+)
+
+#: trailing window for the burn columns, in fine buckets
+BURN_BUCKETS = 12
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    f = float(v)
+    return str(int(f)) if f == int(f) else f"{f:.3f}"
+
+
+def _burn_by_proc(kv) -> dict[str, tuple[float, float, float | None]]:
+    """proc -> (shed, done, burn rate) over the trailing window."""
+    shed_rows = tsdb.read_series(kv, "engine.shed")
+    done_rows = tsdb.read_series(kv, "engine.done")
+    newest = max((r["bucket"] for r in shed_rows + done_rows), default=0)
+    since = newest - BURN_BUCKETS + 1
+    shed = tsdb.window_sum(shed_rows, since_bucket=since, per_proc=True)
+    done = tsdb.window_sum(done_rows, since_bucket=since, per_proc=True)
+    out = {}
+    for proc in sorted(set(shed) | set(done)):
+        s, d = shed.get(proc, 0.0), done.get(proc, 0.0)
+        rate = s / (s + d) if s + d > 0 else None
+        out[proc] = (s, d, rate)
+    return out
+
+
+def render(kv, *, now: float | None = None, max_alerts: int = 8) -> str:
+    """The whole console as one string — pure so tests can assert on it
+    and ``--watch`` can diff it."""
+    now = time.time() if now is None else now
+    lines = [f"fleetop @ {time.strftime('%H:%M:%S', time.localtime(now))}"]
+
+    # -- fleet gauges --------------------------------------------------------
+    lines.append("")
+    lines.append("fleet:")
+    shown = 0
+    for name in FLEET_GAUGES:
+        rows = tsdb.read_series(kv, name)
+        if not rows:
+            continue
+        val = tsdb.latest_value(rows)
+        procs = sorted({r["proc"] for r in rows})
+        lines.append(f"  {name:<24} {_fmt_num(val):>10}   "
+                     f"({len(procs)} producer"
+                     f"{'s' if len(procs) != 1 else ''})")
+        shown += 1
+    series = tsdb.list_series(kv)
+    lines.append(f"  {len(series)} live series from "
+                 f"{len({p for p, _ in series})} processes"
+                 if series else "  no time series published yet")
+
+    # -- per-replica occupancy + burn ---------------------------------------
+    reports = read_load_reports(kv)
+    burns = _burn_by_proc(kv)
+    excluded = health.active_subjects(kv, "replica_burn")
+    lines.append("")
+    lines.append("replicas:")
+    tags = sorted(set(reports) | set(burns))
+    if not tags:
+        lines.append("  none reporting")
+    else:
+        lines.append(f"  {'tag':<16} {'queue':>6} {'active':>7} "
+                     f"{'shed':>6} {'done':>6} {'burn':>7}  routing")
+        for tag in tags:
+            rep = reports.get(tag, {})
+            # load reports key on the raw tag; the tsdb proc name is the
+            # same tag with '/' flattened (see ReplicaWorker)
+            s, d, rate = burns.get(
+                tag, burns.get(tag.replace("/", "-"), (0.0, 0.0, None)))
+            routing = "EXCLUDED" if (
+                tag in excluded or tag.replace("/", "-") in excluded
+            ) else "ok"
+            lines.append(
+                f"  {tag:<16} {_fmt_num(rep.get('queue_depth')):>6} "
+                f"{_fmt_num(rep.get('active')):>7} {_fmt_num(s):>6} "
+                f"{_fmt_num(d):>6} "
+                f"{('-' if rate is None else f'{rate:.1%}'):>7}  {routing}")
+
+    # -- alerts --------------------------------------------------------------
+    active = health.active_alerts(kv)
+    lines.append("")
+    lines.append(f"active alerts ({len(active)}):")
+    for a in active:
+        lines.append(f"  [{a.get('rule', '?')}] {a.get('subject', '?')} "
+                     f"window={a.get('window_idx', '?')}")
+    if not active:
+        lines.append("  none")
+
+    recent = health.alerts(kv)[-max_alerts:]
+    lines.append("")
+    lines.append(f"recent alert records (last {len(recent)}):")
+    for a in recent:
+        age = now - float(a.get("wall", now))
+        lines.append(f"  {age:7.1f}s ago  [{a.get('rule', '?')}] "
+                     f"{a.get('subject', '?')}")
+    if not recent:
+        lines.append("  none")
+
+    # -- postmortem pointers -------------------------------------------------
+    trace_dir = os.environ.get("TPU_SANDBOX_TRACE_DIR", "")
+    if recent:
+        lines.append("")
+        if trace_dir:
+            oldest = now - float(recent[0].get("wall", now)) + 5.0
+            lines.append("postmortem: python tools/tracecat.py "
+                         f"{trace_dir} --last {max(oldest, 5.0):.0f}s")
+        else:
+            lines.append("postmortem: set TPU_SANDBOX_TRACE_DIR and rerun "
+                         "with tracing to get causal timelines")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="KV store host (default 127.0.0.1)")
+    ap.add_argument("--port", type=int, required=True,
+                    help="KV store port")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
+                    help="refresh every N seconds until interrupted")
+    args = ap.parse_args(argv)
+
+    kv = KVClient(args.host, args.port)
+    if args.watch is None:
+        print(render(kv))
+        return 0
+    try:
+        while True:
+            out = render(kv)
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

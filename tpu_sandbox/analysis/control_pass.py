@@ -1,7 +1,7 @@
 """Pass 3: control-plane lint over ``runtime/``, ``serve/``,
 ``gateway/`` and ``obs/`` (AST).
 
-Seven rules distilled from this repo's own elastic-runtime and serving
+Eight rules distilled from this repo's own elastic-runtime and serving
 incident history:
 
 - **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
@@ -48,12 +48,19 @@ incident history:
   the span: a leaked open span never emits its record, so the request
   silently vanishes from the merged timeline — the observability
   equivalent of a lost verdict.
+- **GL-O402** — a ``counter()``/``gauge()``/``histogram()`` call on a
+  metrics registry whose name argument is not a static ``snake.dotted``
+  string literal. A dynamic name (f-string, concatenation, variable)
+  mints one series per distinct value: unbounded cardinality in every
+  snapshot, scrape, and tsdb flush, and nothing stable for alert rules
+  to key on. Bounded dimensions belong in ``labels=``.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 
 from tpu_sandbox.analysis.findings import Finding, make_finding
 
@@ -74,6 +81,13 @@ QUEUE_NAMES = frozenset({
 #: call-name substrings that mark a function as overload-aware — it has
 #: somewhere to put work it refuses (shed verdicts, drop/evict paths)
 SHED_MARKERS = ("shed", "drop", "reject", "evict")
+
+#: instrument factories on a metrics registry (GL-O402)
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+#: the sanctioned metric-name shape: lowercase snake segments joined by
+#: dots, at least two segments ("component.metric")
+METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 
 #: nested scopes a statement walk must not descend into — each is
@@ -476,6 +490,46 @@ class _FnLinter:
                     f"leaks it from the timeline",
                 )
 
+    # -- GL-O402 -------------------------------------------------------------
+
+    @staticmethod
+    def _is_registry_receiver(node: ast.AST) -> bool:
+        """``get_registry().x``, ``reg.x``, ``self.registry.x`` — anything
+        that reads as "the metrics registry". Instrument calls on other
+        objects are out of scope."""
+        if isinstance(node, ast.Call):
+            return _final_attr(node.func) == "get_registry"
+        name = _final_attr(node)
+        if name is None:
+            return False
+        low = name.lstrip("_").lower()
+        return low == "reg" or "registry" in low
+
+    def _check_metric_names(self, fn: ast.AST) -> None:
+        """Instrument names must be static ``snake.dotted`` literals; a
+        name built at runtime mints a series per distinct value."""
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_FACTORIES
+                    and self._is_registry_receiver(node.func.value)):
+                continue
+            name_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            if name_arg is None:
+                continue
+            if isinstance(name_arg, ast.Constant) \
+                    and isinstance(name_arg.value, str) \
+                    and METRIC_NAME_RE.match(name_arg.value):
+                continue
+            self._emit(
+                "GL-O402", node,
+                f"{node.func.attr}() name is not a static snake.dotted "
+                f"literal — a dynamic name mints one series per distinct "
+                f"value (put bounded dimensions in labels=)",
+            )
+
     # -- GL-R304 (per-class, run separately) ---------------------------------
 
     def run_common(self, fn: ast.AST) -> None:
@@ -489,6 +543,7 @@ class _FnLinter:
         self._check_threads(fn)
         self._check_unbounded_queues(fn)
         self._check_span_leaks(fn)
+        self._check_metric_names(fn)
 
 
 def _leader_reachable(cls: ast.ClassDef) -> set[str]:

@@ -129,6 +129,15 @@ RULES: dict[str, tuple[str, str]] = {
         "rec.span(...)`, or assign `sp = rec.begin_span(...)` and follow "
         "it IMMEDIATELY with try/finally sp.close()",
     ),
+    "GL-O402": (
+        "metric name is not a static snake.dotted literal",
+        "a dynamic metric name (f-string, concatenation, variable) mints "
+        "one series per distinct value — unbounded cardinality that "
+        "bloats every registry snapshot, OP_METRICS scrape, and tsdb "
+        "flush, and breaks alert rules keyed on the name; use a static "
+        "'component.metric' literal and carry the bounded dimension in "
+        "labels= (see obs/metrics.py)",
+    ),
 }
 
 

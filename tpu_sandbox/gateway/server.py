@@ -54,6 +54,7 @@ from tpu_sandbox.gateway import wire
 from tpu_sandbox.gateway import routing
 from tpu_sandbox.gateway.fleet import DEFAULT_FLEET, FleetSpec, fleet_kv
 from tpu_sandbox.obs import get_recorder, get_registry
+from tpu_sandbox.obs.health import active_subjects
 from tpu_sandbox.runtime.kvstore import KVClient
 from tpu_sandbox.runtime.supervisor import ENV_KV_PORT
 from tpu_sandbox.serve.cache import chain_digest
@@ -104,6 +105,9 @@ class _FleetState:
     inflight: dict = field(default_factory=dict)   # tag -> routed-unreported
     routes: dict = field(default_factory=dict)     # rid -> tag (bounded)
     last_refresh: float = -1e9
+    # replica tags under an active health-plane replica_burn alert:
+    # excluded from targeted routing until the alert's TTL expires
+    unhealthy: frozenset = frozenset()
 
     def note_route(self, rid: str, tag: str) -> None:
         self.routes.pop(rid, None)
@@ -325,6 +329,12 @@ class Gateway:
         for tag in [t for t in fleet.replicas if t not in seen]:
             del fleet.replicas[tag]
             fleet.inflight.pop(tag, None)
+        # the health plane's verdict rides the same refresh cadence: a
+        # replica with an active per-replica burn alert keeps reporting
+        # (it is alive) but is excluded from targeted routing until the
+        # alert's TTL lapses
+        fleet.unhealthy = frozenset(
+            active_subjects(fleet.kv, "replica_burn"))
 
     def _views(self, fleet: _FleetState) -> list[routing.ReplicaView]:
         now = time.monotonic()
@@ -350,11 +360,14 @@ class Gateway:
         self._refresh(fleet)
         chain = chain_digest(prompt, fleet.spec.block_size)
         views = routing.fresh(self._views(fleet), self.max_report_age_s)
-        if self.policy == "random" and views:
-            v = views[self._rng.randrange(len(views))]
-            choice = (v, routing.match_depth(chain, v))
+        if self.policy == "random":
+            healthy = [v for v in views if v.tag not in fleet.unhealthy]
+            choice = None
+            if healthy:
+                v = healthy[self._rng.randrange(len(healthy))]
+                choice = (v, routing.match_depth(chain, v))
         else:
-            choice = routing.choose(chain, views)
+            choice = routing.choose(chain, views, exclude=fleet.unhealthy)
         if choice is None:
             # nobody has reported yet (fleet warming up): nothing to
             # estimate against, so admit to the shared queue — engine-side
@@ -424,7 +437,8 @@ class Gateway:
         door shed racing a retry's fresh execution still yields exactly
         one terminal verdict per rid."""
         self.stats.shed_door += 1
-        get_registry().counter(f"gateway.shed.door.{reason}").inc()
+        get_registry().counter("gateway.shed.door",
+                               labels={"reason": reason}).inc()
         if fleet.kv.add(k_done(rid)) == 1:
             fleet.kv.set(k_result(rid), json.dumps({
                 "rid": rid, "verdict": "SHED", "reason": f"door:{reason}",
@@ -467,8 +481,8 @@ class Gateway:
         first = fleet.routes.get(rid, "")
         chain = chain_digest(req["prompt"], fleet.spec.block_size)
         views = routing.fresh(self._views(fleet), self.max_report_age_s)
-        choice = routing.choose(
-            chain, views, exclude=frozenset({first}) if first else frozenset())
+        exclude = fleet.unhealthy | ({first} if first else set())
+        choice = routing.choose(chain, views, exclude=frozenset(exclude))
         if choice is None:
             enqueue(fleet.kv, rid)
             replica = ""
@@ -517,9 +531,15 @@ class Gateway:
                 stats = entry.report.get("recorder")
                 if stats is not None:
                     replica_recorders[f"{name or 'default'}/{tag}"] = stats
+        own = get_recorder().stats()
         return {"registry": get_registry().snapshot(),
-                "recorder": get_recorder().stats(),
-                "replica_recorders": replica_recorders}
+                "recorder": own,
+                "replica_recorders": replica_recorders,
+                # fleet-wide drop total: the one number the
+                # recorder_drops health rule and an operator both want
+                "dropped_events": own["dropped"] + sum(
+                    s.get("dropped", 0)
+                    for s in replica_recorders.values())}
 
 
 # -- gateway process main -----------------------------------------------------

@@ -110,7 +110,7 @@ def merge(logs: dict[str, list[dict]],
     for key, records in logs.items():
         off = offsets.get(key, 0.0)
         for r in records:
-            if r.get("ph") not in ("X", "i"):
+            if r.get("ph") not in ("X", "i", "m"):
                 continue
             out = dict(r)
             out["uts"] = float(r["ts"]) + off
@@ -131,8 +131,11 @@ def load_merged(logdir: str) -> list[dict]:
 def to_chrome_trace(merged: list[dict]) -> dict:
     """Render merged records as Chrome/Perfetto trace-event JSON: one
     track (pid) per process, spans as ``"X"`` complete events, fault
-    injections and other point records as ``"i"`` instants. Times are
-    microseconds from the earliest record."""
+    injections and other point records as ``"i"`` instants, metric
+    samples (recorder phase ``"m"``) as ``"C"`` counter events — so
+    Perfetto draws the time-series as counter tracks on the same
+    timeline as the spans. Times are microseconds from the earliest
+    record."""
     if not merged:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     base = min(r["uts"] for r in merged)
@@ -145,6 +148,14 @@ def to_chrome_trace(merged: list[dict]) -> dict:
             events.append({"ph": "M", "name": "process_name",
                            "pid": pids[pkey], "tid": 0,
                            "args": {"name": pkey}})
+        if r["ph"] == "m":
+            events.append({
+                "name": r.get("name", "?"), "ph": "C",
+                "ts": (r["uts"] - base) * 1e6,
+                "pid": pids[pkey], "tid": 0,
+                "args": {"value": float(r.get("value", 0.0))},
+            })
+            continue
         ev = {
             "name": r.get("name", "?"),
             "ph": "X" if r["ph"] == "X" else "i",

@@ -13,12 +13,29 @@ stats from the TTL'd load reports so one scrape sees the whole fleet.
 Histograms keep exact count/sum/min/max plus a fixed-size reservoir
 sample (deterministic seed — reproducible quantile estimates) so
 ``quantile(0.99)`` stays O(reservoir) regardless of observation count.
+
+Metric NAMES are static ``snake.dotted`` literals — graftlint GL-O402
+rejects f-strings and concatenation at registry call sites, because a
+dynamic name mints a new series per distinct value and the time-series
+store downstream would grow without bound. Bounded dimensions (shed
+reason, tenant, replica tag) travel in ``labels=``, which become part
+of the series key as ``name{k=v,...}`` with sorted label keys.
 """
 
 from __future__ import annotations
 
 import random
 import threading
+
+
+def series_key(name: str, labels: dict | None) -> str:
+    """The registry/tsdb series key: ``name`` alone, or
+    ``name{k=v,...}`` with label keys sorted so the same label set
+    always produces the same series."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
 
 
 class Counter:
@@ -118,25 +135,29 @@ class MetricsRegistry:
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str, labels: dict | None = None) -> Counter:
+        key = series_key(name, labels)
         with self._lock:
-            c = self._counters.get(name)
+            c = self._counters.get(key)
             if c is None:
-                c = self._counters[name] = Counter(name)
+                c = self._counters[key] = Counter(key)
             return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, labels: dict | None = None) -> Gauge:
+        key = series_key(name, labels)
         with self._lock:
-            g = self._gauges.get(name)
+            g = self._gauges.get(key)
             if g is None:
-                g = self._gauges[name] = Gauge(name)
+                g = self._gauges[key] = Gauge(key)
             return g
 
-    def histogram(self, name: str, reservoir: int = 512) -> Histogram:
+    def histogram(self, name: str, reservoir: int = 512,
+                  labels: dict | None = None) -> Histogram:
+        key = series_key(name, labels)
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(key)
             if h is None:
-                h = self._histograms[name] = Histogram(name, reservoir)
+                h = self._histograms[key] = Histogram(key, reservoir)
             return h
 
     def snapshot(self) -> dict:

@@ -20,6 +20,12 @@ host's clock):
                       mono midpoint, rtt, wall) — the collector derives
                       per-process offsets from these (see
                       ``obs/collect.py::clock_offsets``)
+    {"ph":"m", ...}   metric sample: (series name, numeric value) —
+                      rendered by the collector as a Chrome/Perfetto
+                      counter track (``ph:"C"`` in the Chrome JSON; the
+                      recorder's own "C" phase was already taken by
+                      calibration) so time-series and spans share one
+                      timeline
 
 Causality is carried by :class:`TraceContext` — ``(trace_id, span_id)``
 pairs serialized as ``{"t":…,"s":…}`` wherever a request body crosses a
@@ -259,6 +265,16 @@ class Recorder:
             "args": args or {},
         }, flush=bool(self.flush_every))
         return ctx
+
+    def metric(self, name: str, value: float) -> None:
+        """Sample a metric series onto the timeline. Buffered like spans
+        (metrics are periodic, not fault markers — losing the tail on
+        SIGKILL is acceptable); the collector turns these into Perfetto
+        counter tracks."""
+        if not self.enabled:
+            return
+        self._emit({"ph": "m", "name": name, "ts": time.monotonic(),
+                    "value": float(value)})
 
     # -- clock calibration ---------------------------------------------------
 

@@ -75,6 +75,12 @@ from tpu_sandbox.runtime.watchdog import Watchdog
 K_SEQ = "sched/seq"
 JOBS_PREFIX = "sched/jobs/"
 K_VTIME_PREFIX = "sched/vtime/"
+#: TTL'd per-tenant queued-job counts — the health plane's evidence that
+#: a starved tenant actually has work waiting (sched/queued/<tenant>)
+K_QUEUED_PREFIX = "sched/queued/"
+#: durable per-job preemption counters (sched/preempts/<job_id>) — the
+#: cascade detector diffs these per evaluation window
+K_PREEMPTS_PREFIX = "sched/preempts/"
 
 #: states a job can be observed in; terminal ones never change again
 QUEUED, RUNNING, PREEMPTING = "queued", "running", "preempting"
@@ -350,6 +356,9 @@ class ClusterScheduler:
         # forgetting every tenant's accumulated debt at each failover
         self._tenant_vtime: dict[str, float] = {}
         self._last_charge = time.monotonic()
+        # jobs already stamped with a `starved` event — the health plane
+        # may hold the alert active for many ticks; the event fires once
+        self._starved_stamped: set[str] = set()
         self._stop = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -465,8 +474,42 @@ class ClusterScheduler:
         self._poll_running()
         self._charge_tenants()
         queued = [j for j in list_jobs(self.kv) if j["state"] == QUEUED]
+        self._publish_queue_state(queued)
         self._admit_or_preempt(queued)
         return [j for j in list_jobs(self.kv) if j["state"] == QUEUED]
+
+    def _publish_queue_state(self, queued: list[dict]) -> None:
+        """Queue-shape metrics into the registry plus the durable
+        per-tenant queued counts the starvation detector cross-checks;
+        also surfaces an active starvation alert as a one-shot
+        ``starved`` job event on every affected queued job."""
+        from tpu_sandbox.obs.health import active_subjects
+
+        reg = get_registry()
+        reg.gauge("sched.queue.depth").set(len(queued))
+        reg.gauge("sched.running").set(len(self._running))
+        counts: dict[str, int] = {}
+        for entry in queued:
+            tenant = entry.get("tenant")
+            if tenant:
+                counts[tenant] = counts.get(tenant, 0) + 1
+        ttl = max(1.0, 10 * self.poll)
+        for tenant, n in counts.items():
+            self.kv.set_ttl(f"{K_QUEUED_PREFIX}{tenant}", str(n), ttl)
+            reg.gauge("sched.tenant.queued",
+                      labels={"tenant": tenant}).set(n)
+        starved = active_subjects(self.kv, "tenant_starvation")
+        if not starved:
+            return
+        for entry in queued:
+            job_id = entry["job_id"]
+            if entry.get("tenant") in starved \
+                    and job_id not in self._starved_stamped:
+                self._starved_stamped.add(job_id)
+                self._stamp_event(job_id, "starved")
+                self._log(f"job {job_id!r}: tenant "
+                          f"{entry['tenant']!r} flagged starved by the "
+                          "health plane")
 
     # -- cancellation -------------------------------------------------------
 
@@ -649,7 +692,8 @@ class ClusterScheduler:
                 # durable ledger: a successor scheduler resumes the
                 # 2:1 convergence instead of resetting every debt
                 self.kv.set(f"{K_VTIME_PREFIX}{tenant}", repr(vt))
-                get_registry().gauge(f"sched.vtime.{tenant}").set(vt)
+                get_registry().gauge("sched.tenant.vtime",
+                                     labels={"tenant": tenant}).set(vt)
 
     def tenant_vtime(self, tenant: str) -> float:
         return self._tenant_vtime.get(tenant, 0.0)
@@ -708,6 +752,9 @@ class ClusterScheduler:
                 victim.preempting = True
                 self.kv.set(k_state(victim.spec.job_id), PREEMPTING)
                 self._stamp_event(victim.spec.job_id, "preempt_sent")
+                get_registry().counter("sched.preemptions").inc()
+                # durable cycle count for the cascade detector
+                self.kv.add(f"{K_PREEMPTS_PREFIX}{victim.spec.job_id}")
                 self._log(
                     f"preempting job {victim.spec.job_id!r} (priority "
                     f"{victim.spec.priority}) to admit "
@@ -845,6 +892,8 @@ class ClusterScheduler:
         self.kv.set(k_state(spec.job_id), RUNNING)
         resumed = self.kv.try_get(k_event(spec.job_id, "admitted"))
         name = "admitted" if resumed is None else "readmitted"
+        get_registry().counter("sched.admissions",
+                               labels={"kind": name}).inc()
         self._stamp_event(spec.job_id, name)
         self._log(
             f"job {spec.job_id!r}: {name} — gang of {spec.hosts} host(s), "

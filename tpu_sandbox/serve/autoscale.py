@@ -38,6 +38,8 @@ import os
 import time
 from dataclasses import dataclass
 
+from tpu_sandbox.obs import get_registry
+from tpu_sandbox.obs.health import active_subjects
 from tpu_sandbox.runtime.election import LeaseElection
 from tpu_sandbox.runtime.scheduler import (TERMINAL_STATES, JobSpec,
                                            cancel_job, list_jobs, submit_job)
@@ -140,6 +142,13 @@ class ReplicaAutoscaler:
         if n < self.cfg.min_replicas:
             # bootstrap / repair: the floor needs no hysteresis
             return self._scale_up(n, depth=0.0, reason="min_replicas")
+        if active_subjects(self.kv, "autoscale_oscillation"):
+            # the health plane caught us flapping: freeze load-driven
+            # scaling (floor repair above still runs) until the alert's
+            # TTL expires — the loop backs off its own oscillation
+            self._up_streak = self._down_streak = 0
+            get_registry().counter("autoscale.backoff").inc()
+            return None
         depth, n_reports = self.load_signal()
         if depth >= self.cfg.scale_up_depth:
             self._up_streak += 1
@@ -203,4 +212,7 @@ class ReplicaAutoscaler:
                  "reason": reason, "wall": time.time(), **extra}
         n = self.kv.add(K_EVENT_TAIL) - 1
         self.kv.set(k_event(n), json.dumps(event))
+        get_registry().counter("autoscale.events",
+                               labels={"action": action}).inc()
+        get_registry().gauge("autoscale.replicas").set(n_after)
         return event

@@ -208,7 +208,7 @@ class _EngineBase:
         ctx = get_recorder().instant(
             f"shed:{reason}", parent=tc if tc is not None else request.tc,
             args={"rid": request.rid})
-        get_registry().counter(f"engine.shed.{reason}").inc()
+        get_registry().counter("engine.shed", labels={"reason": reason}).inc()
         self.shed[request.rid] = ShedRecord(
             rid=request.rid, reason=reason, shed_at=self.clock(),
             preemptions=request.preemptions if preemptions is None
@@ -258,6 +258,7 @@ class _EngineBase:
         request (see ``cache.PagedKVCache.resident_prefix_digest``)."""
         now = self.clock()
         cache = self.cache
+        rec_stats = get_recorder().stats()
         return {
             "queue_depth": len(self.waiting),
             "active": self.active_requests,
@@ -269,7 +270,10 @@ class _EngineBase:
             "shed": len(self.shed),
             "done": len(self.results),
             "prefix_digest": cache.resident_prefix_digest(),
-            "recorder": get_recorder().stats(),
+            "recorder": rec_stats,
+            # a silently-dropping recorder must be visible at the top
+            # level of every load report, not buried in a nested dict
+            "dropped_events": rec_stats["dropped"],
         }
 
     # -- shared mechanics ----------------------------------------------------
@@ -361,6 +365,9 @@ class _EngineBase:
             self._record_shed(req, "deadline", preemptions=slot.preemptions,
                               tc=tc)
             return
+        get_registry().counter("engine.done").inc()
+        get_registry().histogram("engine.ttft").observe(
+            slot.first_token_at - req.arrival)
         self.results[req.rid] = RequestResult(
             rid=req.rid, tokens=list(slot.generated),
             ttft=slot.first_token_at - req.arrival,

@@ -234,10 +234,19 @@ class ReplicaWorker:
     def __init__(self, kv: KVClient, engine, *, tag: str = "replica",
                  lease_ttl: float = 3.0, claim_depth: int | None = None,
                  scavenge_interval: float | None = None,
-                 load_interval: float | None = None):
+                 load_interval: float | None = None,
+                 ts_flusher=None, publish_ts: bool = True):
+        from tpu_sandbox.obs.tsdb import TimeSeriesFlusher
+
         self.kv = kv
         self.engine = engine
         self.tag = tag
+        # durable time-series trail, flushed on the load-report cadence;
+        # the health plane's per-replica rules read it under this proc
+        self.ts_flusher = ts_flusher
+        if self.ts_flusher is None and publish_ts:
+            self.ts_flusher = TimeSeriesFlusher(
+                kv, tag.replace("/", "-") or "replica")
         self.lease_ttl = lease_ttl
         self.claim_depth = claim_depth or 2 * engine.config.max_batch
         self.scavenge_interval = scavenge_interval or lease_ttl
@@ -548,6 +557,8 @@ class ReplicaWorker:
                       wall=time.time())
         self.kv.set_ttl(k_load(self.tag), json.dumps(report),
                         max(3 * self.load_interval, self.lease_ttl))
+        if self.ts_flusher is not None:
+            self.ts_flusher.flush()
 
 
 # -- worker process main -----------------------------------------------------
