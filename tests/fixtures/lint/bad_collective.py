@@ -43,3 +43,30 @@ def rank_while_collective(x, local_rank, axis):  # GL-C101 (while form)
         x = lax.ppermute(x, axis, [(0, 1)])
         local_rank -= 1
     return x
+
+
+class _ShardSyncA:
+    """Same-named methods as ShardSyncB below: the old bare-name table
+    let this class's collective-free ``_sync`` answer for B's, hiding
+    both of B's violations one method away."""
+
+    def _sync(self, tree):
+        return tree  # no collective in A's spelling
+
+    def gated(self, tree, rank):
+        return tree
+
+
+class ShardSyncB:
+    def _sync(self, tree):
+        return lax.psum(tree, "data")  # B's _sync DOES bear a collective
+
+    def maybe_sync(self, tree, rank):  # GL-C103: self-call one method away
+        if rank == 0:
+            tree = self._sync(tree)  # must resolve to ShardSyncB._sync
+        return tree
+
+    def gated(self, tree, rank):  # GL-C101 inside a name-shadowed method
+        if rank == 0:
+            tree = lax.pmean(tree, "data")
+        return tree

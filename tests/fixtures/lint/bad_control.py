@@ -67,3 +67,19 @@ class BadFrontend:
     def submit(self, request):  # GL-R306: no capacity check, no shed path
         self.waiting.append(request)
         return True
+
+
+class _BaseResolver:
+    """The blocking read lives on the base class; only the subclass's
+    ``_leader*`` root makes it leader-reachable."""
+
+    def __init__(self, kv):
+        self.kv = kv
+
+    def _lookup(self, key):
+        return self.kv.get(key)  # blocking — lethal once a leader calls it
+
+
+class BadLeaderSub(_BaseResolver):
+    def _leader_sync(self):  # GL-R304: blocking read one base class away
+        return self._lookup("gen/teardown")

@@ -43,3 +43,27 @@ def early_exit_before_any_collective(x, rank):
     if rank != 0:
         return x  # fine: no collective AFTER the divergent exit
     return x * 2.0
+
+
+class _LoudSync:
+    """Bearing ``_sync``, called unconditionally — clean. Defined FIRST
+    so a bare-name any-match would wrongly answer for _QuietSync below."""
+
+    def _sync(self, tree):
+        return lax.psum(tree, "data")
+
+    def sync(self, tree):
+        return self._sync(tree)  # every rank reaches the psum
+
+
+class _QuietSync:
+    """Collective-free ``_sync``: the rank gate below must stay clean
+    even though _LoudSync owns a bearing method of the same name."""
+
+    def _sync(self, tree):
+        return tree
+
+    def maybe_sync(self, tree, rank):
+        if rank == 0:
+            tree = self._sync(tree)  # resolves to OUR _sync: no finding
+        return tree

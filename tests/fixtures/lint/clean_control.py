@@ -123,3 +123,25 @@ class BoundedFrontend:
         # appendleft is exempt: requeueing already-admitted work adds
         # nothing the bounded queue has not already accepted
         self.waiting.appendleft(request)
+
+
+class _PatientBase:
+    """Blocking ``_lookup`` is fine here: the leader subclass overrides
+    it, and nothing leader-reachable ever calls THIS definition."""
+
+    def __init__(self, kv):
+        self.kv = kv
+
+    def _lookup(self, key):
+        return self.kv.get(key)
+
+    def follower_fetch(self, key):
+        return self._lookup(key)  # followers have no lease to lose
+
+
+class GoodLeaderSub(_PatientBase):
+    def _lookup(self, key):  # override wins: non-blocking under the lease
+        return self.kv.try_get(key)
+
+    def _leader_sync(self):
+        return self._lookup("gen/teardown")

@@ -33,7 +33,7 @@ from tpu_sandbox.gateway import routing, wire
 from tpu_sandbox.gateway.fleet import (FleetSpec, fleet_kv, fleet_namespace)
 from tpu_sandbox.gateway.server import Gateway, live_gateways
 from tpu_sandbox.gateway.client import (GatewayAuthError, GatewayClient,
-                                        GatewayError)
+                                        GatewayError, RetriesExhausted)
 from tpu_sandbox.models.transformer import TransformerConfig
 from tpu_sandbox.serve.cache import (CacheConfig, PagedKVCache, chain_digest)
 from tpu_sandbox.serve.engine import ContinuousEngine, Request, ServeConfig
@@ -362,9 +362,14 @@ def test_door_shed_writes_claim_once_verdict(kv_pair):
     with _gateway(kv, fleets=fleets) as gw:
         with GatewayClient(gw.port, deadline_s=1.0, max_retries=0) as client:
             assert client.submit("r0", [1, 2, 3], 2) is False
-            got = client.result("r0", timeout=10.0)
+            with pytest.raises(RetriesExhausted) as ei:
+                client.result("r0", timeout=10.0)
+    got = ei.value.verdict
     assert got["verdict"] == "SHED" and got["reason"] == "door:infeasible"
     assert got["replica"] == "gateway"
+    assert ei.value.last_reason == "door:infeasible"
+    assert len(ei.value.attempts) == 1
+    assert ei.value.attempts[0]["shed_reason"] == "door:infeasible"
     assert kv.get(k_done("r0")) is not None
     assert json.loads(kv.get(k_result("r0")))["verdict"] == "SHED"
     assert gw.stats.shed_door == 1 and gw.stats.admitted == 0
@@ -391,6 +396,10 @@ def test_client_retries_shed_through_gateway(kv_pair):
     _, kv, clone = kv_pair
     w = _worker(clone(), tag="w0")
     storm = _worker(clone(), tag="storm")
+    # a deadline-carrying submit against zero fresh reports would now
+    # fast-fail at the door (door:no_replicas); this test is about the
+    # retry path, so give routing a live view of w0 up front
+    _fake_report(kv, "w0")
     with _gateway(kv) as gw:
         with GatewayClient(gw.port, deadline_s=30.0,
                            max_retries=2) as client:

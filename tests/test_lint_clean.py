@@ -68,6 +68,16 @@ def test_bad_collective_fixture_fires_every_rule():
     assert "_helper_syncs" in msgs  # rank_branch_calls_helper (via summary)
     assert "all_gather" in msgs     # rank_cond_lambda
     assert "ppermute" in msgs       # rank_while_collective
+    # self-call resolution through the class method table: ShardSyncB's
+    # rank-gated self._sync() fires even though _ShardSyncA owns a
+    # collective-free method of the same name (the old bare-name table
+    # let A answer for B)
+    c103 = [f for f in findings if f.rule == "GL-C103"]
+    assert len(c103) == 2
+    assert any("'_sync'" in f.message for f in c103)
+    # the name-shadowed ShardSyncB.gated is linted as its own function
+    # (it used to be skipped entirely once A.gated took the bare slot)
+    assert sum(1 for f in findings if f.rule == "GL-C101") >= 4
     # findings carry real locations + hints
     assert all(f.line > 0 and f.hint for f in findings)
 
@@ -97,6 +107,10 @@ def test_bad_control_fixture_fires_every_rule():
     # leader-reachability: the blocking get() is inside _resolve, reached
     # from _leader_tick
     assert "_resolve" in by_rule["GL-R304"][0].message
+    # ...and through the inheritance edge: _BaseResolver._lookup is only
+    # leader-reachable via BadLeaderSub's _leader_sync
+    assert len(by_rule["GL-R304"]) == 2
+    assert "BadLeaderSub._lookup" in by_rule["GL-R304"][1].message
     # the launch storm anchors on the dispatch site inside the loop
     assert "_sync_grads" in by_rule["GL-R305"][0].snippet
 

@@ -281,22 +281,32 @@ def test_client_retries_shed_then_succeeds(kv_pair):
     assert client.stats.retries == 1
 
 
-def test_client_returns_terminal_shed_after_retry_budget(kv_pair):
-    from tpu_sandbox.serve.client import ServeClient
+def test_client_raises_retries_exhausted_after_budget(kv_pair):
+    from tpu_sandbox.serve.client import RetriesExhausted, ServeClient
 
     _, kv = kv_pair
     client = ServeClient(kv, max_retries=1)
     # deadline already burnt: every execution sheds
     client.submit("r0", [1, 2, 3], 3, deadline_s=-1.0)
     w = _worker(kv, tag="w0")
-    got = None
+    err = None
     for _ in range(200):
         try:
-            got = client.result("r0", timeout=0.05)
-            break
+            client.result("r0", timeout=0.05)
+            raise AssertionError("terminal shed must raise, not return")
         except TimeoutError:
             w.tick()
-    assert got is not None and got["verdict"] == "SHED"
+        except RetriesExhausted as e:
+            err = e
+            break
+    assert err is not None
+    assert err.rid == "r0" and err.last_reason == "deadline"
+    assert err.verdict["verdict"] == "SHED"
+    # the per-attempt timeline: the original submit plus one retry, each
+    # stamped with its shed reason once resolved
+    assert len(err.attempts) == 2
+    assert all("submitted_at" in a for a in err.attempts)
+    assert [a["shed_reason"] for a in err.attempts] == ["deadline"] * 2
     assert client.stats.retries == 1 and client.stats.shed == 1
 
 

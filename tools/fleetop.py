@@ -10,6 +10,9 @@ Connects to the cluster KV store and renders, from durable state alone
   each replica's shed/done burn rate over the recent window, with
   replicas currently excluded from routing (active ``replica_burn``)
   flagged;
+- the deployment panel: per-replica serving version, each fleet's
+  rollout phase, live canary shares, and the last verdict/rollback from
+  the durable decision log;
 - active alerts (the TTL'd condition flags control planes act on) and
   the most recent durable alert records;
 - postmortem pointers: the ``tracecat`` invocation that reconstructs
@@ -68,6 +71,69 @@ def _burn_by_proc(kv) -> dict[str, tuple[float, float, float | None]]:
     return out
 
 
+def _deploy_panel(kv, reports, lines, now) -> None:
+    """Continuous-deployment state, reconstructed from the registry alone:
+    per-fleet target version, the active rollout's phase, live canary
+    shares, the latest canary verdict, and the most recent rollback."""
+    from tpu_sandbox.deploy.registry import (  # noqa: E402
+        audited_fleets, current_target, deploy_events, fleet_label,
+        read_shares, registry_versions, rollout_phase,
+    )
+
+    fleets = audited_fleets(kv)
+    lines.append("")
+    lines.append("deployment:")
+    if not fleets:
+        lines.append("  no registry state")
+        return
+    events = deploy_events(kv)
+    for fleet in fleets:
+        target = current_target(kv, fleet)
+        versions = registry_versions(kv, fleet)
+        active = None
+        for seq in sorted(versions, reverse=True):
+            ph = rollout_phase(kv, fleet, seq)
+            if ph["rec"] is not None and ph["done"] is None \
+                    and ph["reject"] is None:
+                active = ph
+                break
+        if active is None:
+            phase_desc = "idle"
+        else:
+            verdict = active["verdict"]
+            if verdict is None:
+                phase_desc = f"v{active['ver']} canary"
+            else:
+                phase_desc = (f"v{active['ver']} converging "
+                              f"(canary {verdict.get('outcome', '?')})")
+        lines.append(f"  fleet {fleet}: target v{target}, "
+                     f"{len(versions)} registered, rollout {phase_desc}")
+        shares = read_shares(kv, fleet)
+        if shares:
+            lines.append("    canary shares: " + ", ".join(
+                f"v{v}={s:.0%}" for v, s in sorted(shares.items())))
+        label = fleet_label(fleet)
+        last_verdict = next(
+            (e for e in reversed(events)
+             if e.get("fleet") == label
+             and e.get("action") in ("canary_fail", "promoted")), None)
+        if last_verdict is not None:
+            age = now - float(last_verdict.get("wall", now))
+            lines.append(f"    last canary verdict: "
+                         f"{last_verdict['action']} v"
+                         f"{last_verdict.get('ver', '?')} "
+                         f"({age:.0f}s ago)")
+        last_rb = next(
+            (e for e in reversed(events)
+             if e.get("fleet") == label
+             and e.get("action") == "rolled_back"), None)
+        if last_rb is not None:
+            age = now - float(last_rb.get("wall", now))
+            lines.append(f"    last rollback: v{last_rb.get('ver', '?')} "
+                         f"-> v{last_rb.get('target', '?')} "
+                         f"({age:.0f}s ago)")
+
+
 def render(kv, *, now: float | None = None, max_alerts: int = 8) -> str:
     """The whole console as one string — pure so tests can assert on it
     and ``--watch`` can diff it."""
@@ -103,7 +169,7 @@ def render(kv, *, now: float | None = None, max_alerts: int = 8) -> str:
     if not tags:
         lines.append("  none reporting")
     else:
-        lines.append(f"  {'tag':<16} {'queue':>6} {'active':>7} "
+        lines.append(f"  {'tag':<16} {'ver':>5} {'queue':>6} {'active':>7} "
                      f"{'shed':>6} {'done':>6} {'burn':>7}  routing")
         for tag in tags:
             rep = reports.get(tag, {})
@@ -115,10 +181,13 @@ def render(kv, *, now: float | None = None, max_alerts: int = 8) -> str:
                 tag in excluded or tag.replace("/", "-") in excluded
             ) else "ok"
             lines.append(
-                f"  {tag:<16} {_fmt_num(rep.get('queue_depth')):>6} "
+                f"  {tag:<16} {_fmt_num(rep.get('ver')):>5} "
+                f"{_fmt_num(rep.get('queue_depth')):>6} "
                 f"{_fmt_num(rep.get('active')):>7} {_fmt_num(s):>6} "
                 f"{_fmt_num(d):>6} "
                 f"{('-' if rate is None else f'{rate:.1%}'):>7}  {routing}")
+
+    _deploy_panel(kv, reports, lines, now)
 
     # -- alerts --------------------------------------------------------------
     active = health.active_alerts(kv)

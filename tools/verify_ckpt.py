@@ -24,8 +24,24 @@ failed — a missing sidecar is a provenance gap, not corruption.
     npz  step-00000008.npz  ok        no sidecar (unverified), loads
     npz  step-00000012.npz  CORRUPT   sha256 mismatch — ...
 
+With ``--kv-port`` the audit switches to the **deploy registry**: it
+connects to the cluster KV store, walks every fleet's model registry
+(``deploy/models/<fleet>/<ver>``), re-verifies each registered artifact's
+seal, and reports lifecycle status per version:
+
+    fleet default: target v3, 4 registered
+      v1  superseded   sealed     gc-able   /ckpts/step-00000100
+      v2  rolled_back  sealed     gc-able   /ckpts/step-00000200
+      v3  current      sealed               /ckpts/step-00000300
+      v4  candidate    CORRUPT              /ckpts/step-00000400
+
+Exit 1 when any registered artifact is dangling (record points at a
+directory that no longer exists) or corrupt; rejected versions with
+recorded problems are expected history, not failures.
+
 Runs from a repo checkout without installation:
     python tools/verify_ckpt.py /path/to/ckpt-dir
+    python tools/verify_ckpt.py --kv-port 5999 [--fleet default]
 """
 
 from __future__ import annotations
@@ -47,6 +63,47 @@ def _dir_bytes(step_dir: Path) -> int:
     return sum(p.stat().st_size for p in step_dir.iterdir() if p.is_file())
 
 
+def audit_deploy_registry(host: str, port: int,
+                          fleet: str | None = None) -> int:
+    """Registry-audit mode: lifecycle + seal status of every registered
+    version, straight from the KV store (pure read, never deletes)."""
+    from tpu_sandbox.deploy.registry import audit_registry, audited_fleets
+    from tpu_sandbox.runtime.kvstore import KVClient
+
+    kv = KVClient(host, port)
+    fleets = [fleet] if fleet is not None else audited_fleets(kv)
+    if not fleets:
+        print("no deploy registry state in this store")
+        return 0
+    bad = 0
+    for fl in fleets:
+        report = audit_registry(kv, "" if fl == "default" else fl)
+        print(f"fleet {report['fleet']}: target v{report['target']}, "
+              f"{len(report['versions'])} registered"
+              + (f", {len(report['missing_records'])} allocated but "
+                 f"unrecorded" if report["missing_records"] else ""))
+        for row in report["versions"]:
+            if row["dangling"]:
+                seal = "DANGLING"
+            elif row["sealed"]:
+                seal = "sealed"
+            elif all(p.startswith("torn:") for p in row["problems"]):
+                seal = "torn"
+            else:
+                seal = "CORRUPT"
+            # a rejected version's bad artifact is recorded history; a
+            # bad artifact anywhere else is a live integrity problem
+            if seal in ("DANGLING", "CORRUPT") \
+                    and row["status"] != "rejected":
+                bad += 1
+            print(f"  v{row['ver']}  {row['status']:<12} {seal:<9} "
+                  f"{'gc-able  ' if row['gc_able'] else '         '}"
+                  f"{row['step_dir']}")
+            for p in row["problems"][:4]:
+                print(f"      {p}")
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     _ensure_import_path()
     from tpu_sandbox.train.checkpoint import (
@@ -59,7 +116,8 @@ def main(argv=None) -> int:
         description="re-hash sharded checkpoint steps against their "
                     "manifests; exit 1 on corruption"
     )
-    ap.add_argument("directory", help="checkpoint directory to audit")
+    ap.add_argument("directory", nargs="?",
+                    help="checkpoint directory to audit")
     ap.add_argument("--strict", action="store_true",
                     help="fail on torn (unsealed) steps too, not just "
                          "corrupt ones")
@@ -68,7 +126,21 @@ def main(argv=None) -> int:
                          "always audited when present)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="only print problems and the summary line")
+    ap.add_argument("--kv-port", type=int, default=None,
+                    help="audit the deploy model registry in the KV store "
+                         "at this port instead of a local directory")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="KV store host for --kv-port (default 127.0.0.1)")
+    ap.add_argument("--fleet", default=None,
+                    help="restrict the registry audit to one fleet label")
     args = ap.parse_args(argv)
+
+    if args.kv_port is not None:
+        return audit_deploy_registry(args.host, args.kv_port, args.fleet)
+    if args.directory is None:
+        print("error: a checkpoint directory (or --kv-port) is required",
+              file=sys.stderr)
+        return 2
 
     root = Path(args.directory)
     if not root.is_dir():

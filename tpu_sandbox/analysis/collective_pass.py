@@ -81,18 +81,43 @@ def _cond_desc(test: ast.AST) -> str:
     return s if len(s) <= 60 else s[:57] + "..."
 
 
+def _via_self(func: ast.AST) -> bool:
+    """Is this call target ``self.<something>``? Those resolve through the
+    enclosing class's method table, never by bare-name coincidence."""
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name) and func.value.id == "self")
+
+
+def _base_name(expr: ast.AST) -> str | None:
+    """Trailing name of a base-class expression (``Mixin``, ``mod.Mixin``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
 class _FunctionIndex:
     """Per-module function table + transitive "bears a collective" summary.
 
-    Keys are bare names for module-level functions and ``Class.method`` for
-    methods; ``self.foo()`` call sites resolve against the enclosing class
-    first, then the module level. Nested defs index under their own name
-    (closures calling helpers defined alongside them still resolve).
+    Keys are bare names for module-level functions and ``Class.method``
+    for methods. ``self.foo()`` call sites resolve through the enclosing
+    class's method table — own methods first, then same-module bases
+    (BFS) — so two classes with a same-named method never shadow each
+    other (the bug this replaces: the first ``_sync`` in the file used to
+    win the bare-name slot and answer for every class). Plain-name calls
+    resolve to the module-level function when one exists, else any-match
+    across same-named methods (the conservative choice for ``obj.foo()``
+    where ``obj``'s class is unknown). Nested defs index under their own
+    name (closures calling helpers defined alongside them still resolve).
     """
 
     def __init__(self, tree: ast.Module):
         self.functions: dict[str, ast.AST] = {}
-        self._class_of: dict[str, str | None] = {}
+        #: every function exactly once: (key, enclosing class | None, node)
+        self.entries: list[tuple[str, str | None, ast.AST]] = []
+        self._bare: dict[str, list[str]] = {}
+        self._bases: dict[str, list[str]] = {}
         self._collect(tree, None)
         self.bearing = self._summarize()
 
@@ -100,20 +125,49 @@ class _FunctionIndex:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 key = f"{cls}.{child.name}" if cls else child.name
-                self.functions.setdefault(key, child)
-                self.functions.setdefault(child.name, child)
-                self._class_of[child.name] = cls
+                if key in self.functions:  # redefinition / nested twin
+                    n = 2
+                    while f"{key}#{n}" in self.functions:
+                        n += 1
+                    key = f"{key}#{n}"
+                self.functions[key] = child
+                self.entries.append((key, cls, child))
+                self._bare.setdefault(child.name, []).append(key)
                 self._collect(child, cls)
             elif isinstance(child, ast.ClassDef):
+                self._bases[child.name] = [
+                    b for b in map(_base_name, child.bases) if b
+                ]
                 self._collect(child, child.name)
             else:
                 self._collect(child, cls)
 
-    def _direct_facts(self, fn: ast.AST) -> tuple[bool, set[str]]:
-        """(has a literal collective, names of functions it calls) —
+    def resolve(self, name: str, cls: str | None,
+                via_self: bool) -> list[str]:
+        """Candidate table keys a call to ``name`` may reach from a
+        function whose enclosing class is ``cls``."""
+        if via_self:
+            seen: set[str] = set()
+            queue = [cls] if cls else []
+            while queue:
+                c = queue.pop(0)
+                if c in seen:
+                    continue
+                seen.add(c)
+                key = f"{c}.{name}"
+                if key in self.functions:
+                    return [key]  # nearest definition wins, like the MRO
+                queue.extend(self._bases.get(c, []))
+            return []  # not in this module's hierarchy: unknowable
+        if name in self.functions:
+            return [name]
+        return list(self._bare.get(name, []))
+
+    def _direct_facts(self, fn: ast.AST) -> tuple[bool, set]:
+        """(has a literal collective, (via_self, name) of calls it makes) —
         counting only this function's own body, not nested defs."""
         has = False
-        calls: set[str] = set()
+        calls: set[tuple[bool, str]] = set()
         for node in ast.walk(fn):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node is not fn:
@@ -123,28 +177,35 @@ class _FunctionIndex:
                 if name in COLLECTIVE_NAMES:
                     has = True
                 elif name:
-                    calls.add(name)
+                    calls.add((_via_self(node.func), name))
         return has, calls
 
     def _summarize(self) -> dict[str, bool]:
-        facts = {
-            key: self._direct_facts(fn)
-            for key, fn in self.functions.items()
-        }
-        bearing = {key: has for key, (has, _) in facts.items()}
+        facts = {}
+        for key, cls, fn in self.entries:
+            has, calls = self._direct_facts(fn)
+            facts[key] = (cls, has, calls)
+        bearing = {key: has for key, (_, has, _) in facts.items()}
         changed = True
         while changed:  # fixed point over the (acyclic-enough) call graph
             changed = False
-            for key, (_, calls) in facts.items():
+            for key, (cls, _, calls) in facts.items():
                 if bearing[key]:
                     continue
-                if any(bearing.get(c, False) for c in calls):
-                    bearing[key] = True
-                    changed = True
+                for via_self, name in calls:
+                    if any(bearing.get(t, False)
+                           for t in self.resolve(name, cls, via_self)):
+                        bearing[key] = True
+                        changed = True
+                        break
         return bearing
 
-    def bears_collective(self, name: str | None) -> bool:
-        return bool(name) and self.bearing.get(name, False)
+    def bears_collective(self, name: str | None, *, cls: str | None = None,
+                         via_self: bool = False) -> bool:
+        if not name:
+            return False
+        return any(self.bearing.get(k, False)
+                   for k in self.resolve(name, cls, via_self))
 
 
 class _FunctionLinter(ast.NodeVisitor):
@@ -152,11 +213,12 @@ class _FunctionLinter(ast.NodeVisitor):
     rank-conditioned early exits; nested defs are linted independently."""
 
     def __init__(self, path: str, lines: list[str], index: _FunctionIndex,
-                 findings: list[Finding]):
+                 findings: list[Finding], cls: str | None = None):
         self.path = path
         self.lines = lines
         self.index = index
         self.findings = findings
+        self.cls = cls  # enclosing class: scopes self.-call resolution
         self._rank_depth = 0          # inside how many rank-like branches
         self._divergent_exit: tuple[int, str] | None = None  # (line, cond)
         self._rank_names: set[str] = set()  # names assigned from axis_index
@@ -214,7 +276,8 @@ class _FunctionLinter(ast.NodeVisitor):
                     f"collective '{name}' sits after the rank-conditioned "
                     f"early exit at line {ln} (if {cond}: ...)",
                 )
-        elif self.index.bears_collective(name):
+        elif self.index.bears_collective(name, cls=self.cls,
+                                         via_self=_via_self(node.func)):
             if self._rank_depth:
                 self._emit(
                     "GL-C103", node,
@@ -242,7 +305,9 @@ class _FunctionLinter(ast.NodeVisitor):
                 if isinstance(sub, ast.Call):
                     name = _call_name(sub.func)
                     if name in COLLECTIVE_NAMES or \
-                            self.index.bears_collective(name):
+                            self.index.bears_collective(
+                                name, cls=self.cls,
+                                via_self=_via_self(sub.func)):
                         self._emit(
                             "GL-C101", site,
                             f"lax.cond on a rank-derived predicate runs "
@@ -251,7 +316,11 @@ class _FunctionLinter(ast.NodeVisitor):
                         return
         elif isinstance(branch, (ast.Name, ast.Attribute)):
             name = branch.id if isinstance(branch, ast.Name) else branch.attr
-            if self.index.bears_collective(name):
+            ref_self = (isinstance(branch, ast.Attribute)
+                        and isinstance(branch.value, ast.Name)
+                        and branch.value.id == "self")
+            if self.index.bears_collective(name, cls=self.cls,
+                                           via_self=ref_self):
                 self._emit(
                     "GL-C103", site,
                     f"lax.cond on a rank-derived predicate calls "
@@ -329,7 +398,9 @@ class _FunctionLinter(ast.NodeVisitor):
                         if isinstance(c, ast.Call):
                             name = _call_name(c.func)
                             if name in COLLECTIVE_NAMES or \
-                                    self.index.bears_collective(name):
+                                    self.index.bears_collective(
+                                        name, cls=self.cls,
+                                        via_self=_via_self(c.func)):
                                 self._emit(
                                     "GL-C101", sub,
                                     f"collective-bearing '{name}' inside a "
@@ -350,10 +421,8 @@ def lint_source(source: str, path: str) -> list[Finding]:
     lines = source.splitlines()
     index = _FunctionIndex(tree)
     findings: list[Finding] = []
-    for key, fn in index.functions.items():
-        if "." in key:
-            continue  # every function also indexes under its bare name
-        linter = _FunctionLinter(path, lines, index, findings)
+    for _key, cls, fn in index.entries:
+        linter = _FunctionLinter(path, lines, index, findings, cls)
         linter.lint_function(fn)
     return findings
 

@@ -1,5 +1,5 @@
 """Pass 3: control-plane lint over ``runtime/``, ``serve/``,
-``gateway/`` and ``obs/`` (AST).
+``gateway/``, ``obs/`` and ``deploy/`` (AST).
 
 Eight rules distilled from this repo's own elastic-runtime and serving
 incident history:
@@ -19,9 +19,10 @@ incident history:
   Non-daemon threads outlive crashed owners and trip the conftest
   ``_no_resource_leaks`` check.
 - **GL-R304** — blocking ``kv.get(...)`` reachable from a leader-action
-  method (``_leader*`` roots, intra-class call graph). A blocking read
-  can park the leader past its lease TTL; leader ticks must use
-  ``try_get`` and re-observe next tick.
+  method (``_leader*`` roots; the ``self.``-call graph spans same-module
+  base classes, so a helper one inheritance edge away is still seen). A
+  blocking read can park the leader past its lease TTL; leader ticks
+  must use ``try_get`` and re-observe next tick.
 - **GL-R305** — a Python ``for``/``while`` loop dispatching a
   *multi-device* jitted computation (one whose body runs a collective,
   or a ``shard_map``) per iteration. Every dispatch is a fresh
@@ -546,12 +547,48 @@ class _FnLinter:
         self._check_metric_names(fn)
 
 
-def _leader_reachable(cls: ast.ClassDef) -> set[str]:
-    """Method names reachable from ``_leader*`` roots via ``self._x()``."""
-    methods = {
+def _base_label(expr: ast.AST) -> str | None:
+    """Trailing name of a base-class expression (``Base``, ``mod.Base``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _class_method_table(
+    cls: ast.ClassDef, class_map: dict[str, ast.ClassDef],
+    _seen: set[str] | None = None,
+) -> dict[str, ast.AST]:
+    """The class's effective method table: own methods plus same-module
+    base methods (own overrides win; bases merge left-to-right, nearest
+    definition first — the static shadow of the MRO). A ``_leader*`` tick
+    that calls ``self._lookup()`` defined on a mixin is exactly as
+    blocking as one defined inline, so GL-R304 must see through the
+    inheritance edge."""
+    seen = set() if _seen is None else _seen
+    if cls.name in seen:  # cycle guard: malformed code must not recurse
+        return {}
+    seen.add(cls.name)
+    table: dict[str, ast.AST] = {
         n.name: n for n in cls.body
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
     }
+    for base in cls.bases:
+        bname = _base_label(base)
+        if bname in class_map:
+            for name, fn in _class_method_table(
+                    class_map[bname], class_map, seen).items():
+                table.setdefault(name, fn)
+    return table
+
+
+def _leader_reachable(
+    cls: ast.ClassDef, class_map: dict[str, ast.ClassDef],
+) -> tuple[set[str], dict[str, ast.AST]]:
+    """(method names reachable from ``_leader*`` roots via ``self._x()``,
+    the class's merged method table)."""
+    methods = _class_method_table(cls, class_map)
     calls: dict[str, set[str]] = {}
     for name, fn in methods.items():
         out: set[str] = set()
@@ -571,35 +608,45 @@ def _leader_reachable(cls: ast.ClassDef) -> set[str]:
             if callee not in reachable:
                 reachable.add(callee)
                 frontier.append(callee)
-    return reachable
+    return reachable, methods
 
 
 def _check_leader_blocking_reads(
-    cls: ast.ClassDef, path: str, lines: list[str],
-    findings: list[Finding],
+    cls: ast.ClassDef, class_map: dict[str, ast.ClassDef],
+    path: str, lines: list[str], findings: list[Finding],
+    reported: set[int],
 ) -> None:
-    reachable = _leader_reachable(cls)
+    """``reported`` dedupes by method node identity across classes: a
+    base method reached from two subclasses is one finding, attributed to
+    the first reaching class."""
+    reachable, methods = _leader_reachable(cls, class_map)
     if not reachable:
         return
-    for node in cls.body:
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    ordered = sorted(
+        ((n, methods[n]) for n in reachable),
+        key=lambda item: getattr(item[1], "lineno", 0),
+    )
+    for method_name, node in ordered:
+        if id(node) in reported:
             continue
-        if node.name not in reachable:
-            continue
+        hit = False
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call) \
                     and isinstance(sub.func, ast.Attribute) \
                     and sub.func.attr == "get" \
                     and _is_kv_receiver(sub.func.value):
+                hit = True
                 ln = getattr(sub, "lineno", 0)
                 snippet = lines[ln - 1].strip() \
                     if 0 < ln <= len(lines) else ""
                 findings.append(make_finding(
                     "GL-R304", path, ln,
                     f"blocking kv.get() inside leader-reachable "
-                    f"'{cls.name}.{node.name}' can outlast the lease TTL",
+                    f"'{cls.name}.{method_name}' can outlast the lease TTL",
                     snippet=snippet,
                 ))
+        if hit:
+            reported.add(id(node))
 
 
 # -- GL-R305 (module-level) --------------------------------------------------
@@ -749,11 +796,17 @@ def lint_source(source: str, path: str) -> list[Finding]:
     helpers = _KeyHelperIndex(tree)
     findings: list[Finding] = []
     linter = _FnLinter(path, lines, helpers, findings)
+    class_map = {
+        node.name: node for node in ast.walk(tree)
+        if isinstance(node, ast.ClassDef)
+    }
+    reported: set[int] = set()
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             linter.run_common(node)
         elif isinstance(node, ast.ClassDef):
-            _check_leader_blocking_reads(node, path, lines, findings)
+            _check_leader_blocking_reads(node, class_map, path, lines,
+                                         findings, reported)
     _check_launch_storms(tree, path, lines, findings)
     return findings
 
@@ -765,7 +818,7 @@ def run_control_pass(
     explicit ``paths``); labels are root-relative."""
     if paths is None:
         paths = []
-        for pkg in ("runtime", "serve", "gateway", "obs"):
+        for pkg in ("runtime", "serve", "gateway", "obs", "deploy"):
             pkg_dir = os.path.join(root, "tpu_sandbox", pkg)
             if os.path.isdir(pkg_dir):
                 for fn in sorted(os.listdir(pkg_dir)):

@@ -1,0 +1,3 @@
+"""Deployment plane: durable model registry + leader-elected rollout
+control (see :mod:`tpu_sandbox.deploy.registry` and
+:mod:`tpu_sandbox.deploy.controller`)."""

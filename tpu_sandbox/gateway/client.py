@@ -22,11 +22,14 @@ from __future__ import annotations
 
 import socket
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tpu_sandbox.gateway import wire
 from tpu_sandbox.obs import get_recorder, get_registry
-from tpu_sandbox.serve.client import ClientStats
+from tpu_sandbox.serve.client import ClientStats, RetriesExhausted
+
+__all__ = ["GatewayClient", "GatewayError", "GatewayAuthError",
+           "RetriesExhausted"]
 
 
 @dataclass
@@ -40,6 +43,8 @@ class _Pending:
     submitted_at: float = 0.0
     retries_left: int = 0
     hedged: bool = False
+    # one entry per submit/retry: {submitted_at, shed_reason?, resolved_at?}
+    attempts: list = field(default_factory=list)
 
 
 class GatewayError(Exception):
@@ -110,6 +115,7 @@ class GatewayClient:
                      temperature=temperature, top_k=top_k, seed=seed,
                      submitted_at=time.time(),
                      retries_left=self.max_retries)
+        p.attempts.append({"submitted_at": p.submitted_at})
         self._pending[rid] = p
         self.stats.submitted += 1
         return self._submit_body(rid, p)
@@ -132,7 +138,10 @@ class GatewayClient:
 
     def result(self, rid: str, timeout: float = 60.0) -> dict:
         """Block until ``rid`` has a terminal verdict, retrying sheds and
-        hedging stragglers. Same contract as ``ServeClient.result``."""
+        hedging stragglers. Same contract as ``ServeClient.result``: the
+        "ok" verdict is returned; a shed that outlives the retry budget
+        raises :class:`RetriesExhausted` (a rid this client never
+        submitted gets its SHED verdict back as data)."""
         p = self._pending.get(rid)
         deadline = time.monotonic() + timeout
         while True:
@@ -152,15 +161,29 @@ class GatewayClient:
                 self._pending.pop(rid, None)
                 self.stats.completed += 1
                 return verdict
-            if p is None or p.retries_left <= 0:
-                self._pending.pop(rid, None)
+            if p is None:
                 self.stats.shed += 1
                 return verdict
-            self._retry(rid, p)
+            if p.retries_left <= 0:
+                self._pending.pop(rid, None)
+                self.stats.shed += 1
+                if p.attempts:
+                    p.attempts[-1].update(
+                        shed_reason=verdict.get("reason", ""),
+                        resolved_at=time.time())
+                raise RetriesExhausted(rid, verdict, p.attempts)
+            self._retry(rid, p, verdict)
 
-    def _retry(self, rid: str, p: _Pending) -> None:
+    def _retry(self, rid: str, p: _Pending,
+               verdict: dict | None = None) -> None:
         p.retries_left -= 1
+        if p.attempts:
+            p.attempts[-1].update(
+                shed_reason="" if verdict is None
+                else verdict.get("reason", ""),
+                resolved_at=time.time())
         p.submitted_at = time.time()
+        p.attempts.append({"submitted_at": p.submitted_at})
         p.hedged = False
         self._checked(wire.OP_CLEAR, {"rid": rid})
         self._submit_body(rid, p)  # fresh deadline, fresh routing

@@ -46,6 +46,9 @@ class ReplicaView:
     age_s: float = 0.0
     #: requests this gateway routed here that the report predates
     pending_local: int = 0
+    #: weight version the replica last acked (deploy rolling updates);
+    #: 0 = boot weights / old-format report
+    ver: int = 0
 
     @property
     def load(self) -> int:
@@ -67,6 +70,7 @@ def parse_report(tag: str, report: dict, *, age_s: float,
         digest=frozenset(report.get("prefix_digest", ())),
         age_s=age_s,
         pending_local=pending_local,
+        ver=int(report.get("ver", 0)),
     )
 
 
@@ -106,6 +110,30 @@ def choose(chain: list[str], views: list[ReplicaView], *,
     if depth == 0:
         return least_loaded(views), 0
     return best, depth
+
+
+def pick_by_share(shares: dict[int, float], draw: float) -> int | None:
+    """Weighted draw over version-pinned traffic shares (the canary
+    split): ``draw`` in [0, 1) lands in one version's normalized share
+    band. Deterministic given the draw, ordered by version so the split
+    is replayable. None when the shares carry no weight."""
+    vers = sorted(v for v in shares if shares[v] > 0)
+    total = sum(shares[v] for v in vers)
+    if total <= 0:
+        return None
+    acc = 0.0
+    for v in vers:
+        acc += shares[v] / total
+        if draw < acc:
+            return v
+    return vers[-1]
+
+
+def pin_version(views: list[ReplicaView], ver: int) -> list[ReplicaView]:
+    """Views currently running weight version ``ver`` — the canary split
+    routes within this subset (caller falls back to all views when no
+    fresh replica has acked ``ver`` yet)."""
+    return [v for v in views if v.ver == int(ver)]
 
 
 def estimate_completion_s(view: ReplicaView, service_rate_rps: float) -> float:

@@ -57,9 +57,10 @@ from tpu_sandbox.obs import get_recorder, get_registry
 from tpu_sandbox.obs.health import active_subjects
 from tpu_sandbox.runtime.kvstore import KVClient
 from tpu_sandbox.runtime.supervisor import ENV_KV_PORT
+from tpu_sandbox.deploy.registry import read_shares
 from tpu_sandbox.serve.cache import chain_digest
 from tpu_sandbox.serve.replica import (enqueue, enqueue_to, k_done, k_lease,
-                                       k_req, k_result, write_request)
+                                       k_pin, k_req, k_result, write_request)
 
 #: rid -> routed-replica memory per fleet, for hedge target exclusion; a
 #: bounded ring — forgetting an old route only costs hedge precision
@@ -108,6 +109,9 @@ class _FleetState:
     # replica tags under an active health-plane replica_burn alert:
     # excluded from targeted routing until the alert's TTL expires
     unhealthy: frozenset = frozenset()
+    # live canary traffic shares {version: share} from the deploy
+    # controller (deploy/shares/<fleet>), None outside a canary phase
+    shares: dict | None = None
 
     def note_route(self, rid: str, tag: str) -> None:
         self.routes.pop(rid, None)
@@ -335,6 +339,9 @@ class Gateway:
         # alert's TTL lapses
         fleet.unhealthy = frozenset(
             active_subjects(fleet.kv, "replica_burn"))
+        # canary traffic shares live at the store ROOT (the deploy plane
+        # spans fleets), keyed by the fleet's name
+        fleet.shares = read_shares(self._kv, fleet.spec.name)
 
     def _views(self, fleet: _FleetState) -> list[routing.ReplicaView]:
         now = time.monotonic()
@@ -360,6 +367,17 @@ class Gateway:
         self._refresh(fleet)
         chain = chain_digest(prompt, fleet.spec.block_size)
         views = routing.fresh(self._views(fleet), self.max_report_age_s)
+        if fleet.shares:
+            # canary split: draw a version by share, route within the
+            # replicas acked at that version. No fresh replica at the
+            # drawn version yet (swap mid-ack) -> route over everyone;
+            # the version pin at claim keeps correctness regardless —
+            # shares are a traffic split, never a correctness gate.
+            drawn = routing.pick_by_share(fleet.shares, self._rng.random())
+            if drawn is not None:
+                pinned = routing.pin_version(views, drawn)
+                if pinned:
+                    views = pinned
         if self.policy == "random":
             healthy = [v for v in views if v.tag not in fleet.unhealthy]
             choice = None
@@ -369,9 +387,24 @@ class Gateway:
         else:
             choice = routing.choose(chain, views, exclude=fleet.unhealthy)
         if choice is None:
-            # nobody has reported yet (fleet warming up): nothing to
-            # estimate against, so admit to the shared queue — engine-side
-            # guardrails still apply once a replica claims it
+            if deadline_s is not None and self.admission == "feasible":
+                # a deadline-carrying request against a fleet with ZERO
+                # fresh reports cannot have its feasibility estimated —
+                # and a dead fleet would let it rot until the client's
+                # whole retry budget burned. Fast-fail at the door with
+                # the same claim-once verdict slot as door:infeasible.
+                route_ctx = rec.complete(
+                    "route", t_route, parent=body.get("tc"),
+                    args={"rid": rid, "routed": "none"})
+                with rec.span("door:no_replicas", parent=route_ctx,
+                              args={"rid": rid}):
+                    self._door_shed(fleet, rid, "no_replicas", 0.0)
+                return wire.ST_OK, {"admitted": False,
+                                    "reason": "no_replicas",
+                                    "estimate_s": 0.0, "replica": ""}
+            # no deadline to defend (or admission is not feasibility-
+            # based): admit to the shared queue — a warming-up fleet will
+            # claim it, and engine-side guardrails still apply
             route_ctx = rec.complete("route", t_route, parent=body.get("tc"),
                                      args={"rid": rid, "routed": "shared"})
             with rec.span("enqueue", parent=route_ctx,
@@ -500,6 +533,9 @@ class Gateway:
         rid = body["rid"]
         fleet.kv.delete(k_result(rid))
         fleet.kv.delete(k_done(rid))
+        # a retry is a NEW lifecycle: drop the weight-version pin so the
+        # fresh execution pins whatever its claimer currently runs
+        fleet.kv.delete(k_pin(rid))
         self.stats.clears += 1
         return wire.ST_OK, {"rid": rid}
 

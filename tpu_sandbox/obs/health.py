@@ -219,6 +219,60 @@ class ThresholdRule:
         return fired
 
 
+@dataclass(frozen=True)
+class BaselineDeltaRule:
+    """Compare one process's newest series value against the pooled
+    baseline of a set of incumbent processes — the canary-analysis shape:
+    the subject is the freshly-swapped replica, the baseline is everyone
+    still on the incumbent version. Fires when the subject breaches
+    ``baseline * threshold`` (``mode="ratio"``) or ``baseline +
+    threshold`` (``mode="delta"``) in the direction of ``op``. Same
+    no-verdict discipline as :class:`BurnRateRule`: a side with no data
+    (no traffic yet, TTL'd rows expired) yields no verdict rather than a
+    false one."""
+
+    name: str
+    series: str
+    subject: str                # tsdb proc name of the canary
+    baseline: tuple[str, ...]   # tsdb proc names of the incumbents
+    threshold: float
+    mode: str = "delta"         # "delta" | "ratio"
+    op: str = ">"               # ">" fires above the bound, "<" below
+    field: str | None = None    # histogram digest field (None -> gauge)
+
+    def evaluate(self, kv, now_bucket: int) -> list[tuple[str, dict]]:
+        del now_bucket  # like ThresholdRule: newest point, not a window
+        rows = tsdb.read_series(kv, self.series)
+        subject_v = tsdb.latest_value(rows, proc=self.subject,
+                                      field=self.field)
+        base_vals = [v for p in self.baseline
+                     if (v := tsdb.latest_value(rows, proc=p,
+                                                field=self.field))
+                     is not None]
+        if subject_v is None or not base_vals:
+            return []  # no traffic on a side -> no verdict
+        base = sum(base_vals) / len(base_vals)
+        bound = base * self.threshold if self.mode == "ratio" \
+            else base + self.threshold
+        breached = subject_v > bound if self.op == ">" else subject_v < bound
+        if not breached:
+            return []
+        return [(self.subject,
+                 {"value": subject_v, "baseline": base, "bound": bound,
+                  "mode": self.mode, "op": self.op, "series": self.series,
+                  "n_baseline": len(base_vals)})]
+
+    def has_data(self, kv) -> bool:
+        """True when BOTH sides have live points — the controller counts
+        a canary evaluation as evidence only when this holds."""
+        rows = tsdb.read_series(kv, self.series)
+        if tsdb.latest_value(rows, proc=self.subject,
+                             field=self.field) is None:
+            return False
+        return any(tsdb.latest_value(rows, proc=p, field=self.field)
+                   is not None for p in self.baseline)
+
+
 def default_rules(*, ttft_deadline_s: float | None = None,
                   goodput_floor: float | None = None,
                   shed_budget: float = 0.05) -> list:

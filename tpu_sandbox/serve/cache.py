@@ -196,6 +196,19 @@ class PagedKVCache:
             return False
         return True
 
+    def flush_prefix_cache(self) -> int:
+        """Drop every prefix-cache entry (the cache's own reference); blocks
+        still pinned by live sequences survive via their remaining refs.
+        Called on a weight swap: cached K/V was computed under the old
+        weights and must never serve a request pinned to the new version.
+        Returns the number of entries flushed."""
+        n = 0
+        for hh, bid in list(self._prefix.items()):
+            del self._prefix[hh]
+            self._decref(bid)
+            n += 1
+        return n
+
     # -- device-facing views -------------------------------------------------
 
     def block_table(self, alloc: SeqAlloc) -> np.ndarray:

@@ -26,8 +26,8 @@ import time
 from dataclasses import dataclass, field
 
 from tpu_sandbox.runtime.kvstore import _backoff_delays
-from tpu_sandbox.serve.replica import (enqueue, k_done, k_lease, k_req,
-                                       k_result, submit_request)
+from tpu_sandbox.serve.replica import (enqueue, k_done, k_lease, k_pin,
+                                       k_req, k_result, submit_request)
 
 
 @dataclass
@@ -37,6 +37,22 @@ class ClientStats:
     shed: int = 0
     retries: int = 0
     hedges: int = 0
+
+
+class RetriesExhausted(RuntimeError):
+    """The retry budget burned out on terminal sheds. Typed — a caller
+    under an SLO must distinguish "the system refused after every retry"
+    from a verdict dict it might forget to check — and carries the
+    evidence: the last shed reason and the per-attempt timeline."""
+
+    def __init__(self, rid: str, verdict: dict, attempts: list[dict]):
+        self.rid = rid
+        self.verdict = verdict
+        self.last_reason = verdict.get("reason", "")
+        self.attempts = attempts
+        super().__init__(
+            f"retries exhausted for {rid}: last shed reason "
+            f"{self.last_reason!r} after {len(attempts)} attempt(s)")
 
 
 @dataclass
@@ -50,6 +66,8 @@ class _Pending:
     submitted_at: float = 0.0
     retries_left: int = 0
     hedged: bool = False
+    # one entry per submit/retry: {submitted_at, shed_reason?, resolved_at?}
+    attempts: list = field(default_factory=list)
 
 
 class ServeClient:
@@ -77,6 +95,7 @@ class ServeClient:
                      temperature=temperature, top_k=top_k, seed=seed,
                      submitted_at=time.time(),
                      retries_left=self.max_retries)
+        p.attempts.append({"submitted_at": p.submitted_at})
         submit_request(
             self.kv, rid, p.prompt, p.max_new_tokens,
             deadline_unix=None if d is None else p.submitted_at + d,
@@ -86,9 +105,11 @@ class ServeClient:
 
     def result(self, rid: str, timeout: float = 60.0) -> dict:
         """Block until ``rid`` has a terminal verdict, retrying sheds and
-        hedging stragglers along the way. Returns the verdict body (check
-        ``verdict``: "ok" carries tokens, "SHED" means the system refused
-        after all retries)."""
+        hedging stragglers along the way. Returns the "ok" verdict body
+        (tokens and metadata). A shed that outlives the retry budget
+        raises :class:`RetriesExhausted` — except for rids this client
+        never submitted (no budget to speak of), whose SHED verdict is
+        returned as data."""
         p = self._pending.get(rid)
         deadline = time.monotonic() + timeout
         while True:
@@ -102,11 +123,18 @@ class ServeClient:
                         self._pending.pop(rid, None)
                         self.stats.completed += 1
                         return verdict
-                    if p is None or p.retries_left <= 0:
-                        self._pending.pop(rid, None)
+                    if p is None:
                         self.stats.shed += 1
                         return verdict
-                    self._retry(rid, p)
+                    if p.retries_left <= 0:
+                        self._pending.pop(rid, None)
+                        self.stats.shed += 1
+                        if p.attempts:
+                            p.attempts[-1].update(
+                                shed_reason=verdict.get("reason", ""),
+                                resolved_at=time.time())
+                        raise RetriesExhausted(rid, verdict, p.attempts)
+                    self._retry(rid, p, verdict)
                     break
                 if p is not None:
                     self._maybe_hedge(rid, p)
@@ -114,16 +142,25 @@ class ServeClient:
             else:
                 raise TimeoutError(f"no verdict for {rid} within {timeout}s")
 
-    def _retry(self, rid: str, p: _Pending) -> None:
+    def _retry(self, rid: str, p: _Pending,
+               verdict: dict | None = None) -> None:
         """Re-enqueue a shed request with a fresh deadline. The old verdict
         and its claim marker are cleared first so the replay can publish —
         by the time the client sees a SHED it is terminal, nobody else
-        writes that slot again."""
+        writes that slot again. The weight-version pin goes too: a retry
+        is a new lifecycle and pins whatever its claimer then runs."""
         p.retries_left -= 1
+        if p.attempts:
+            p.attempts[-1].update(
+                shed_reason="" if verdict is None
+                else verdict.get("reason", ""),
+                resolved_at=time.time())
         p.submitted_at = time.time()
+        p.attempts.append({"submitted_at": p.submitted_at})
         p.hedged = False
         self.kv.delete(k_result(rid))
         self.kv.delete(k_done(rid))
+        self.kv.delete(k_pin(rid))
         submit_request(
             self.kv, rid, p.prompt, p.max_new_tokens,
             deadline_unix=None if p.deadline_s is None

@@ -86,6 +86,8 @@ class Request:
     temperature: float = 0.0       # 0 -> greedy argmax
     top_k: int = 0                 # 0 -> full vocab
     seed: int = 0                  # sampler key; folded with the step index
+    ver: int | None = None         # pinned weight version; None -> pin to the
+                                   # engine's current version at admit
     tc: dict | None = None         # trace context (wire form); never affects
                                    # tokens, only the flight recorder
 
@@ -98,6 +100,7 @@ class RequestResult:
     itl: list[float]              # inter-token latencies (s)
     finished_at: float = 0.0
     preemptions: int = 0
+    ver: int = 0                  # weight version every token was decoded on
     tc: dict | None = None        # decode span context; parents the verdict
 
 
@@ -122,17 +125,43 @@ class _Slot:
     last_token_at: float | None = None
     itl: list[float] = field(default_factory=list)
     preemptions: int = 0
+    ver: int = 0                      # weight version this slot decodes on
+    logprob_sum: float = 0.0          # sum of chosen-token logprobs
     tc: dict | None = None            # admit span context
     admitted_mono: float | None = None  # real monotonic time of admission
                                         # (the engine clock may be a fake)
 
 
+#: "this version is not resident" — distinct from None, which is a valid
+#: params value for stub-step engines that never touch weights
+_MISSING = object()
+
+
+def _token_logprob(logits_row: np.ndarray, token: int) -> float:
+    """Logprob of ``token`` under fp32 ``logits_row`` (stable logsumexp).
+    Fed into the ``engine.logprob`` series the canary analysis compares —
+    a weight regression shows up as the model scoring its own chosen
+    tokens lower, with no reference labels needed."""
+    row = np.asarray(logits_row, np.float64)
+    m = float(row.max())
+    return float(row[int(token)] - m - np.log(np.exp(row - m).sum()))
+
+
 class _EngineBase:
     def __init__(self, params, config: ServeConfig,
                  step: DecodeStep | None = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 version: int = 0, loader: Callable | None = None):
         self.config = config
-        self.params = params
+        # weights are versioned: requests pin the version they started on
+        # and decode on it to the last token, even across a swap (grouped
+        # decode below). The boot version is retained forever — it is the
+        # rollback target when nothing was ever promoted.
+        self.version = int(version)
+        self._boot_version = int(version)
+        self._params_by_ver: dict[int, Any] = {int(version): params}
+        self.loader = loader  # optional: ver -> params | None, for pinned
+                              # versions this process never held (post-respawn)
         self.step_fns = step or build_decode_step(
             config.model, config.cache, max_batch=config.max_batch,
             buckets=config.buckets, cache_dtype=config.cache_dtype)
@@ -151,8 +180,54 @@ class _EngineBase:
     # -- public --------------------------------------------------------------
 
     @property
+    def params(self):
+        """The *current* version's weights (the long-standing single-version
+        API; versioned access goes through ``_params_for``)."""
+        return self._params_by_ver[self.version]
+
+    @params.setter
+    def params(self, value) -> None:
+        self._params_by_ver[self.version] = value
+
+    @property
     def active_requests(self) -> int:
         return sum(1 for s in self.slots if s is not None)
+
+    def swap_params(self, params, version: int) -> int:
+        """Install ``params`` as weight ``version`` and make it current,
+        between decode steps. Resident paged-KV state is NOT drained: live
+        slots keep decoding on the version they pinned at admit (grouped
+        decode), only the prefix cache is flushed — its K/V was computed
+        under other weights. Returns the number of cache entries flushed."""
+        self._params_by_ver[int(version)] = params
+        self.version = int(version)
+        flushed = self.cache.flush_prefix_cache()
+        self._gc_params()
+        get_registry().counter("engine.swap").inc()
+        return flushed
+
+    def has_version(self, ver: int) -> bool:
+        return int(ver) in self._params_by_ver
+
+    def _params_for(self, ver: int):
+        """Weights for ``ver``, or the ``_MISSING`` sentinel (None is a
+        valid params value — stub engines run weightless)."""
+        ver = int(ver)
+        if ver in self._params_by_ver:
+            return self._params_by_ver[ver]
+        if self.loader is not None:
+            params = self.loader(ver)
+            if params is not None:
+                self._params_by_ver[ver] = params
+                return params
+        return _MISSING
+
+    def _gc_params(self) -> None:
+        keep = {self.version, self._boot_version}
+        keep.update(s.ver for s in self.slots if s is not None)
+        keep.update(int(r.ver) for r in self.waiting if r.ver is not None)
+        for ver in [v for v in self._params_by_ver if v not in keep]:
+            del self._params_by_ver[ver]
 
     def submit(self, request: Request) -> bool:
         """Admit ``request`` to the waiting queue. Returns False when the
@@ -262,6 +337,7 @@ class _EngineBase:
         return {
             "queue_depth": len(self.waiting),
             "active": self.active_requests,
+            "ver": self.version,  # the swap ack the deploy controller reads
             "max_batch": self.config.max_batch,
             "free_block_frac": cache.free_blocks / cache.config.num_blocks,
             "steps": self.steps,
@@ -277,6 +353,25 @@ class _EngineBase:
         }
 
     # -- shared mechanics ----------------------------------------------------
+
+    def _admit_from_waiting(self) -> bool:
+        """Admit (or resolve) the queue head. True = the head was consumed
+        (admitted, or shed because its pinned version is gone); False = the
+        head is blocked on capacity and the loop should stop."""
+        req = self.waiting[0]
+        ver = self.version if req.ver is None else int(req.ver)
+        if self._params_for(ver) is _MISSING:
+            # the pinned weights no longer exist in this process (respawn
+            # after a swap, no loader): an explicit shed verdict, so the
+            # client restarts a fresh single-version lifecycle — never a
+            # silent decode on different weights than the pin
+            self.waiting.popleft()
+            self._record_shed(req, "stale_version")
+            return True
+        if not self._try_admit(req):
+            return False
+        self.waiting.popleft()
+        return True
 
     def _try_admit(self, request: Request) -> bool:
         free = [i for i, s in enumerate(self.slots) if s is None]
@@ -294,19 +389,28 @@ class _EngineBase:
     def _prefill(self, request: Request, alloc: SeqAlloc, slot_idx: int):
         cfg = self.config
         t_admit = time.monotonic()
+        ver = self.version if request.ver is None else int(request.ver)
+        request.ver = ver  # pin sticks to the request: preempt-to-requeue
+                           # and drain replay on these weights, swap or not
+        params = self._params_for(ver)
+        if params is _MISSING:
+            raise KeyError(
+                f"request {request.rid} pinned to version {ver} but no such "
+                f"params are resident (admit through the queue, which sheds "
+                f"stale pins, or provide a loader)")
         plen = len(request.prompt)
         bucket = self.step_fns.pick_bucket(plen)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :plen] = request.prompt
         dest = self.cache.dest_indices(alloc, bucket).astype(np.int32)
         next_logits, self.k_pages, self.v_pages = self.step_fns.prefill[bucket](
-            self.params, self.k_pages, self.v_pages,
+            params, self.k_pages, self.v_pages,
             jnp.asarray(toks), jnp.asarray(dest),
             jnp.asarray(plen - 1, jnp.int32))
         alloc.length = plen
         self.cache.commit_prefix(alloc)
         slot = _Slot(request=request, alloc=alloc, tokens=list(request.prompt),
-                     preemptions=request.preemptions)
+                     preemptions=request.preemptions, ver=ver)
         # the admit span covers the prefill compute; the decode span that
         # follows is emitted retrospectively at retire time, anchored here
         ctx = get_recorder().complete("admit", t_admit, parent=request.tc,
@@ -314,7 +418,10 @@ class _EngineBase:
         slot.tc = None if ctx is None else ctx.to_wire()
         slot.admitted_mono = time.monotonic()
         self.slots[slot_idx] = slot
-        self._emit_token(slot, self._pick_token(slot, np.asarray(next_logits)))
+        row = np.asarray(next_logits).reshape(-1)
+        token = self._pick_token(slot, row)
+        slot.logprob_sum += _token_logprob(row, token)
+        self._emit_token(slot, token)
         if self._finished(slot):
             self._retire(slot_idx)
 
@@ -368,11 +475,13 @@ class _EngineBase:
         get_registry().counter("engine.done").inc()
         get_registry().histogram("engine.ttft").observe(
             slot.first_token_at - req.arrival)
+        get_registry().histogram("engine.logprob").observe(
+            slot.logprob_sum / max(1, len(slot.generated)))
         self.results[req.rid] = RequestResult(
             rid=req.rid, tokens=list(slot.generated),
             ttft=slot.first_token_at - req.arrival,
             itl=list(slot.itl), finished_at=self.clock(),
-            preemptions=slot.preemptions, tc=tc)
+            preemptions=slot.preemptions, ver=slot.ver, tc=tc)
 
     def _preempt(self, i: int) -> None:
         """Evict slot i back to the waiting queue (front: it has seniority)."""
@@ -402,38 +511,53 @@ class _EngineBase:
         return True
 
     def _decode_active(self) -> None:
-        """One compiled decode step over every occupied slot."""
+        """One compiled decode step over every occupied slot. Around a
+        weight swap the batch can hold slots pinned to different versions:
+        one decode call runs per resident version, with the other
+        versions' rows zeroed out (length 0 masks their reads, table 0
+        scatters their writes to the null block — exactly the treatment
+        empty slots already get), so every sequence decodes every token on
+        the weights it pinned at admit, never a blend."""
         B = self.config.max_batch
         cfg = self.config.cache
-        tokens = np.zeros((B, 1), np.int32)
-        lengths = np.zeros((B,), np.int32)
-        tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
         # resolve capacity for every slot first: growing one slot may
         # preempt another that was already swept, so the batch is built
         # only from the survivors
         for i in range(B):
             if self.slots[i] is not None and not self._ensure_capacity(i):
                 self._preempt(i)
-        live = []
+        by_ver: dict[int, list[int]] = {}
         for i, slot in enumerate(self.slots):
-            if slot is None:
-                continue
-            live.append(i)
-            tokens[i, 0] = slot.tokens[-1]
-            lengths[i] = len(slot.tokens)
-            tables[i] = self.cache.block_table(slot.alloc)
-        if not live:
+            if slot is not None:
+                by_ver.setdefault(slot.ver, []).append(i)
+        if not by_ver:
             return
-        logits, self.k_pages, self.v_pages = self.step_fns.decode(
-            self.params, self.k_pages, self.v_pages,
-            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables))
-        logits = np.asarray(logits)
+        rows: dict[int, np.ndarray] = {}
+        for ver in sorted(by_ver):
+            members = by_ver[ver]
+            tokens = np.zeros((B, 1), np.int32)
+            lengths = np.zeros((B,), np.int32)
+            tables = np.zeros((B, cfg.max_blocks_per_seq), np.int32)
+            for i in members:
+                slot = self.slots[i]
+                tokens[i, 0] = slot.tokens[-1]
+                lengths[i] = len(slot.tokens)
+                tables[i] = self.cache.block_table(slot.alloc)
+            logits, self.k_pages, self.v_pages = self.step_fns.decode(
+                self._params_by_ver[ver], self.k_pages, self.v_pages,
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(tables))
+            logits = np.asarray(logits)
+            for i in members:
+                rows[i] = logits[i]
         self.steps += 1
         self.last_step_at = self.clock()
-        for i in live:
+        for i in sorted(rows):
             slot = self.slots[i]
             slot.alloc.length = len(slot.tokens)
-            self._emit_token(slot, self._pick_token(slot, logits[i]))
+            token = self._pick_token(slot, rows[i])
+            slot.logprob_sum += _token_logprob(rows[i], token)
+            self._emit_token(slot, token)
             if self._finished(slot):
                 self._retire(i)
 
@@ -445,9 +569,8 @@ class ContinuousEngine(_EngineBase):
     def step(self) -> None:
         self.shed_expired()
         while self.waiting:
-            if not self._try_admit(self.waiting[0]):
+            if not self._admit_from_waiting():
                 break
-            self.waiting.popleft()
         self._decode_active()
 
 
@@ -458,6 +581,7 @@ class StaticEngine(_EngineBase):
     def step(self) -> None:
         self.shed_expired()
         if self.active_requests == 0:
-            while self.waiting and self._try_admit(self.waiting[0]):
-                self.waiting.popleft()
+            while self.waiting:
+                if not self._admit_from_waiting():
+                    break
         self._decode_active()

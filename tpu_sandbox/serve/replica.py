@@ -29,8 +29,17 @@ the whole request plane:
                          races a scavenged duplicate execution
     serve/load/<tag>     TTL'd per-replica load report (queue depth,
                          block-pool pressure, decode-step lag) — the
-                         autoscaler's input
-    serve/cmd/<tag>      fault mailbox (shed_storm / stall_replica)
+                         autoscaler's input; also carries the running
+                         weight version (``ver``), which is the swap ack
+                         the deploy controller advances on
+    serve/pin/<rid>      weight-version pin, written by the first claimer:
+                         every later execution of the rid (requeue,
+                         scavenge, drain) decodes on this version, so a
+                         verdict is always single-version and replays are
+                         bitwise. Cleared only by a client retry, which
+                         starts a fresh lifecycle.
+    serve/cmd/<tag>      fault mailbox (shed_storm / stall_replica /
+                         swap — the deploy controller's rolling update)
     serve/total          number of distinct requests the producer will pose
 
 Loss cases and their answers:
@@ -95,6 +104,10 @@ def k_result(rid: str) -> str:
 
 def k_done(rid: str) -> str:
     return f"serve/done/{rid}"
+
+
+def k_pin(rid: str) -> str:
+    return f"serve/pin/{rid}"
 
 
 def k_load(tag: str) -> str:
@@ -223,6 +236,8 @@ class ReplicaStats:
     scavenged: int = 0
     shed: int = 0
     stalls: int = 0
+    swaps: int = 0
+    swap_errors: int = 0
 
 
 class ReplicaWorker:
@@ -235,7 +250,8 @@ class ReplicaWorker:
                  lease_ttl: float = 3.0, claim_depth: int | None = None,
                  scavenge_interval: float | None = None,
                  load_interval: float | None = None,
-                 ts_flusher=None, publish_ts: bool = True):
+                 ts_flusher=None, publish_ts: bool = True,
+                 swap_loader=None):
         from tpu_sandbox.obs.tsdb import TimeSeriesFlusher
 
         self.kv = kv
@@ -256,6 +272,11 @@ class ReplicaWorker:
         self._tq_hole_slot = -1   # targeted slot seen tail-bumped but empty
         self._tq_hole_since = 0.0
         self._published: set[str] = set()
+        self._pin_skipped: set[str] = set()
+        # swap command -> params hook (tests/benches inject stub weights);
+        # None falls back to the artifact path in the command
+        self.swap_loader = swap_loader
+        self._swap_error: dict | None = None
         self._next_scavenge = time.monotonic() + self.scavenge_interval
         self._next_load = 0.0  # publish on the first tick
         self.stats = ReplicaStats()
@@ -351,6 +372,16 @@ class ReplicaWorker:
         # (the claim-once serve/done marker still arbitrates races).
         self._published.discard(rid)
         req = self._to_request(body)
+        # version pin: the FIRST claimer of a rid stamps the weight version
+        # it will decode on; every re-execution (requeue, scavenge, another
+        # replica) reads the pin back and decodes on the same version, so
+        # the published verdict is single-version and bitwise-replayable
+        pin_raw = self.kv.try_get(k_pin(rid))
+        if pin_raw is not None:
+            req.ver = int(pin_raw)
+        else:
+            req.ver = int(self.engine.version)
+            self.kv.set(k_pin(rid), str(req.ver))
         ctx = get_recorder().complete(
             "claim", t_claim, parent=body.get("tc"),
             args={"rid": rid, "replica": self.tag})
@@ -393,6 +424,59 @@ class ReplicaWorker:
         elif action == "stall_replica":
             self.stats.stalls += 1
             time.sleep(float(cmd.get("duration", 2 * self.lease_ttl)))
+        elif action == "swap":
+            self._apply_swap(cmd)
+
+    def _apply_swap(self, cmd: dict) -> None:
+        """Install the commanded weight version between decode steps.
+        Verify-before-touch: a manifest that fails its checksums leaves the
+        engine exactly as it was, with the error in the load report (the
+        controller reads it and rolls back). Idempotent — the controller
+        re-sends until the load report acks the version, so a replica
+        killed mid-swap just swaps again after respawn."""
+        ver = int(cmd.get("ver", 0))
+        if ver == self.engine.version:
+            return  # already there: a re-sent command, not an error
+        step_dir = cmd.get("step_dir")
+        if step_dir:
+            from tpu_sandbox.train.checkpoint import verify_step_dir
+
+            problems = verify_step_dir(step_dir)
+            if problems:
+                self._swap_error = {"ver": ver, "error": "verify",
+                                    "problems": [str(p) for p in problems][:4]}
+                self.stats.swap_errors += 1
+                return
+        params, loaded = None, False
+        if self.swap_loader is not None:
+            params = self.swap_loader(cmd)
+            loaded = params is not None
+        elif step_dir:
+            from tpu_sandbox.deploy.registry import load_step_params
+
+            try:
+                params = load_step_params(step_dir, self.engine.params)
+                loaded = True
+            except Exception as exc:  # torn mid-read, shape mismatch, ...
+                self._swap_error = {"ver": ver, "error": "load",
+                                    "problems": [str(exc)[:200]]}
+                self.stats.swap_errors += 1
+                return
+        elif self.engine.has_version(ver):
+            # no artifact and no hook: a rollback to weights this process
+            # still holds (None is valid params for stub engines)
+            params = self.engine._params_by_ver[ver]
+            loaded = True
+        if not loaded:
+            self._swap_error = {"ver": ver, "error": "no_params"}
+            self.stats.swap_errors += 1
+            return
+        flushed = self.engine.swap_params(params, ver)
+        self._swap_error = None
+        self.stats.swaps += 1
+        get_recorder().instant(
+            "swap", args={"replica": self.tag, "ver": ver,
+                          "prefix_flushed": flushed})
 
     def drain(self) -> int:
         """Requeue everything in flight; the SIGTERM path. Finished-but-
@@ -516,6 +600,20 @@ class ReplicaWorker:
         for rid, res in self.engine.results.items():
             if rid in self._published:
                 continue
+            # pin fence: an execution that somehow ran on a different
+            # version than the rid's pin (pin written by a racing claimer
+            # after our claim) must not publish — let the lease lapse and
+            # the scavenger replay it on the pinned version
+            pin_raw = self.kv.try_get(k_pin(rid))
+            if pin_raw is not None and int(pin_raw) != int(
+                    getattr(res, "ver", 0)):
+                if rid not in self._pin_skipped:
+                    self._pin_skipped.add(rid)
+                    get_recorder().instant(
+                        "verdict:pin_mismatch",
+                        args={"rid": rid, "ran": getattr(res, "ver", 0),
+                              "pin": int(pin_raw)})
+                continue
             # the verdict INSTANT is trace-only; the verdict BODY below is
             # untouched, so bitwise-identical republication still holds
             get_recorder().instant(
@@ -524,6 +622,7 @@ class ReplicaWorker:
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "ok", "tokens": res.tokens,
                 "preemptions": res.preemptions, "replica": self.tag,
+                "ver": int(getattr(res, "ver", 0)),
                 "ttft_s": round(res.ttft, 6)})
             self.stats.completed += 1
         for rid, rec in self.engine.shed.items():
@@ -555,6 +654,8 @@ class ReplicaWorker:
         self._next_load = now + self.load_interval
         report = dict(self.engine.load_report(), tag=self.tag,
                       wall=time.time())
+        if self._swap_error is not None:
+            report["swap_error"] = self._swap_error
         self.kv.set_ttl(k_load(self.tag), json.dumps(report),
                         max(3 * self.load_interval, self.lease_ttl))
         if self.ts_flusher is not None:

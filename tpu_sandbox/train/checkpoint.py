@@ -1059,6 +1059,53 @@ class CheckpointVerifier:
         self.stop()
 
 
+# -- single-rank export: the train->serve weight handoff ----------------------
+#
+# Serving replicas hold one host-addressable params tree; the deploy
+# registry hands them sealed artifacts in the same ShardedCheckpoint
+# format training already trusts (manifest + SHA-256 per shard, seal
+# written last). Exports are always world_size=1 — the trainer collapses
+# its sharded state to a host view first — so a replica restore is two
+# files and one hash pass, with no cross-process commit to wait on.
+
+def export_params(
+    directory: str | os.PathLike, params, step: int, *,
+    extra: dict | None = None, compress: bool = False,
+) -> Path:
+    """Seal ``params`` as a one-rank sharded step under ``directory`` and
+    return the sealed step dir. Raises when the commit did not complete —
+    an unsealed export must never be registered for promotion. Exports are
+    never pruned here; lifecycle (GC of superseded versions) belongs to
+    the deploy registry audit, which knows which versions are still
+    rollback targets."""
+    spec = jax.tree.map(lambda _: "rep", params)
+    ck = ShardedCheckpoint(
+        directory, rank=0, world_size=1, kv=None,
+        keep=1_000_000_000, verbose=False, compress=compress,
+    )
+    ok = ck.save(params, spec, int(step), epoch=0, offset=0,
+                 extra=dict(extra or {}, exported=True))
+    if not ok:
+        raise RuntimeError(
+            f"export of step {step} under {directory} did not seal"
+        )
+    return ck.step_dir(int(step))
+
+
+def load_exported_params(step_dir: str | os.PathLike, template):
+    """Restore a sealed single-rank export (strict: checksum-verified,
+    fail-loud) into ``template``'s structure. Returns the params tree."""
+    sd = Path(step_dir).absolute()
+    step = _parse_step_dir(sd)
+    if step is None:
+        raise ValueError(f"{step_dir} is not a step-XXXXXXXX directory")
+    ck = ShardedCheckpoint(
+        sd.parent, rank=0, world_size=1, kv=None, verbose=False,
+    )
+    tree, _meta = ck.restore(template, step=step)
+    return tree
+
+
 def restore(
     directory: str | os.PathLike, template: TrainState, step: int | None = None
 ) -> TrainState:

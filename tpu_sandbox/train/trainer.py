@@ -363,6 +363,28 @@ def _maybe_prefetch(loader, prefetch: bool):
     return PrefetchLoader(loader)
 
 
+# -- train -> serve handoff -------------------------------------------------
+
+
+def publish_checkpoint(kv, params, *, export_dir, step: int,
+                       fleet: str = "", extra: dict | None = None,
+                       compress: bool = False) -> int:
+    """Seal ``params`` as a one-rank export and register it in the deploy
+    model registry; returns the allocated version number. This is the
+    trainer's side of the zero-downtime handoff: the export either seals
+    completely (manifest written last) or raises — a torn artifact is
+    never registered, and the DeployController re-verifies checksums
+    before any replica is told to load it. Imports stay lazy so the plain
+    training path never pulls in the deploy plane."""
+    from tpu_sandbox.deploy.registry import publish_version
+    from tpu_sandbox.train.checkpoint import export_params
+
+    step_dir = export_params(export_dir, params, int(step), extra=extra,
+                             compress=compress)
+    return publish_version(kv, step_dir, fleet=fleet, step=int(step),
+                           extra=extra)
+
+
 # -- elastic / resumable training -----------------------------------------
 
 class Preempted(RuntimeError):
