@@ -1804,6 +1804,396 @@ def bench_obs(*, quick: bool = False, seed: int = 0) -> dict:
     }
 
 
+def bench_critpath(*, quick: bool = False, seed: int = 0) -> dict:
+    """Trace-analytics receipts: does critical-path attribution explain
+    the wall clock, does tracediff gate real regressions (and only real
+    ones), and does the online pipeline-bubble gauge agree with the
+    trace?
+
+    Four measurements, all chipless:
+
+    1. **Attribution coverage** — a gateway-served open-loop modeled
+       fleet (real sockets/KV/engine, sleep-modeled step) run with the
+       recorder on; every served request's causal critical path is
+       attributed to named segments. The claim: >= 95% of request wall
+       attributed, residue reported as ``unattributed``.
+    2. **Regression gating** — the same fleet rerun twice: once
+       identically, once with decode modeled ~20% slower.
+       ``tools/tracediff.py`` must flag the slowdown (exit 1, decode
+       named) while passing the identical rerun (exit 0) — the noise
+       floor separates real regressions from run-to-run jitter.
+    3. **Online bubble accounting** — a 2-stage / 4-microbatch 1F1B
+       pipeline over sleep-modeled stage programs. The online
+       ``mpmd.bubble_fraction`` gauge (read back through the tsdb
+       ring), the offline trace-derived bubble, and the analytic
+       ``(S-1)/(M+S-1) = 0.2`` (BENCH_r07's offline measurement) must
+       agree within 5 points.
+    4. **Workload export** — the control run's trace exports as a
+       canonical replayable workload trace that round-trips
+       byte-identically through dumps -> loads -> dumps.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import contextlib
+    import statistics
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from tpu_sandbox.gateway import FleetSpec, Gateway, GatewayClient
+    from tpu_sandbox.models.transformer import TransformerConfig
+    from tpu_sandbox.mpmd.driver import StageWorker
+    from tpu_sandbox.mpmd.transport import LocalTransport
+    from tpu_sandbox.obs import (ENV_TRACE_DIR, collect, critpath,
+                                 get_recorder, reset_recorder, tsdb,
+                                 workload)
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+    from tpu_sandbox.serve.cache import CacheConfig
+    from tpu_sandbox.serve.engine import ContinuousEngine, ServeConfig
+    from tpu_sandbox.serve.replica import ReplicaWorker
+
+    @contextlib.contextmanager
+    def recorder_arm(trace_dir):
+        prior = os.environ.pop(ENV_TRACE_DIR, None)
+        if trace_dir is not None:
+            os.environ[ENV_TRACE_DIR] = trace_dir
+        reset_recorder()
+        try:
+            yield
+        finally:
+            get_recorder().flush()
+            if prior is None:
+                os.environ.pop(ENV_TRACE_DIR, None)
+            else:
+                os.environ[ENV_TRACE_DIR] = prior
+            reset_recorder()
+
+    # -- 1+2. gateway fleet: control / identical rerun / slow decode ---------
+    BLOCK = 8
+    PREFILL_TOKEN_S = 0.4e-3
+    DECODE_STEP_S = 10e-3
+    n_requests = 12 if quick else 32
+    max_new = 8
+    mcfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=128)
+    ccfg = CacheConfig(num_blocks=48, block_size=BLOCK, max_blocks_per_seq=8)
+
+    class _ModeledStep:
+        buckets = (32,)
+        vocab = 64
+
+        def __init__(self, decode_step_s=DECODE_STEP_S):
+            self.decode_step_s = decode_step_s
+            self.prefill = {b: self._prefill for b in self.buckets}
+
+        def pick_bucket(self, plen):
+            for b in self.buckets:
+                if plen <= b:
+                    return b
+            raise ValueError(f"prompt of {plen} exceeds {self.buckets}")
+
+        def _prefill(self, params, k, v, toks, dest, last):
+            uncached = int(np.count_nonzero(np.asarray(dest)))
+            time.sleep(PREFILL_TOKEN_S * uncached)
+            toks = np.asarray(toks)
+            logits = np.zeros((self.vocab,), np.float32)
+            logits[(int(toks[0, int(last)]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+        def decode(self, params, k, v, tokens, lengths, tables):
+            time.sleep(self.decode_step_s)
+            tokens = np.asarray(tokens)
+            logits = np.zeros((tokens.shape[0], self.vocab), np.float32)
+            for i in range(tokens.shape[0]):
+                logits[i, (int(tokens[i, 0]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+    prefix = [int(t) for t in
+              np.random.default_rng(seed).integers(1, 64, 2 * BLOCK)]
+
+    def run_fleet(tag, decode_step_s=DECODE_STEP_S):
+        """One isolated 2-replica fleet pass. A fresh rng seeded the
+        same way every pass: identical arrivals/prompts, so profiles
+        pair request-for-request and only the modeled costs differ."""
+        rng = np.random.default_rng(seed + 1)
+        server = KVServer()
+        kv = KVClient(port=server.port)
+        stop = threading.Event()
+        workers, threads, clones = [], [], []
+        for i in range(2):
+            wkv = kv.clone()
+            clones.append(wkv)
+            eng = ContinuousEngine(
+                None,
+                ServeConfig(model=mcfg, cache=ccfg, max_batch=4,
+                            buckets=_ModeledStep.buckets, max_waiting=0),
+                step=_ModeledStep(decode_step_s))
+            w = ReplicaWorker(wkv, eng, tag=f"{tag}{i}", lease_ttl=1.0,
+                              load_interval=0.05)
+            workers.append(w)
+
+            def loop(worker=w):
+                while not stop.is_set():
+                    worker.tick()
+                    if worker.engine.idle:
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name=f"critpath-replica-{tag}{i}")
+            threads.append(t)
+            t.start()
+        gw = Gateway(kv, [FleetSpec(block_size=BLOCK)], admission="none",
+                     refresh_min_s=0.01, max_report_age_s=2.0).start()
+        client = GatewayClient(gw.port, max_retries=0)
+        time.sleep(0.2)
+        try:
+            offs = np.cumsum(rng.exponential(0.12, n_requests))
+            t0 = time.monotonic()
+            rids = []
+            for i in range(n_requests):
+                now = time.monotonic() - t0
+                if offs[i] > now:
+                    time.sleep(offs[i] - now)
+                rid = f"{tag}-{i}"
+                suffix = [int(t) for t in
+                          rng.integers(1, 64, int(rng.integers(4, 9)))]
+                if client.submit(rid, prefix + suffix, max_new):
+                    rids.append(rid)
+            for rid in rids:
+                _terminal_verdict(client, rid, 120.0)
+        finally:
+            client.close()
+            gw.close()
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            for w in workers:
+                w.engine.drain_to_requests()
+            for c in clones:
+                c.close()
+            kv.close()
+            server.stop()
+
+    with recorder_arm(None):
+        run_fleet("warm")  # cold sockets/threads, discarded
+    dirs = {arm: tempfile.mkdtemp(prefix=f"critpath-{arm}-")
+            for arm in ("ctrl", "same", "slow")}
+    with recorder_arm(dirs["ctrl"]):
+        run_fleet("ctl")
+    with recorder_arm(dirs["same"]):
+        run_fleet("sam")
+    with recorder_arm(dirs["slow"]):
+        run_fleet("slo", decode_step_s=DECODE_STEP_S * 1.2)
+
+    profiles = {}
+    merged_ctrl = None
+    for arm, d in dirs.items():
+        merged = collect.load_merged(d)
+        if arm == "ctrl":
+            merged_ctrl = merged
+        analysis = critpath.analyze(merged)
+        profiles[arm] = analysis["profile"]
+        critpath.save_profile(
+            analysis["profile"], os.path.join(d, "critpath_profile.json"))
+    prof = profiles["ctrl"]
+    covs = [r["coverage"] for r in critpath.analyze(merged_ctrl)["requests"]]
+    frac_covered = (sum(1 for c in covs if c >= critpath.COVERAGE_TARGET)
+                    / len(covs)) if covs else 0.0
+
+    # the gate itself, end to end: the committed CLI on the saved profiles
+    td = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "tracediff.py")
+    # gate on segments carrying >= 5% of wall: the modeled workload's
+    # signal lives in decode/prefill; the sub-3% control segments
+    # (route, claim, queue_wait) jitter with host scheduling noise
+    MIN_SHARE = 0.05
+    gate = {}
+    for arm in ("same", "slow"):
+        r = subprocess.run(
+            [sys.executable, td,
+             os.path.join(dirs["ctrl"], "critpath_profile.json"),
+             os.path.join(dirs[arm], "critpath_profile.json"),
+             "--min-share", str(MIN_SHARE)],
+            capture_output=True, text=True)
+        gate[arm] = r.returncode
+    cmp_slow = critpath.compare_profiles(profiles["ctrl"], profiles["slow"],
+                                         min_share=MIN_SHARE)
+    cmp_same = critpath.compare_profiles(profiles["ctrl"], profiles["same"],
+                                         min_share=MIN_SHARE)
+    decode_row = next((r for r in cmp_slow["segments"]
+                       if r["segment"] == "decode"), {})
+
+    # -- 3. online vs offline vs analytic pipeline bubble --------------------
+    S, M = 2, 4
+    OP_S = 8e-3
+    mpmd_steps = 5 if quick else 8
+
+    class _StubStage:
+        """Sleep-modeled stage program with uniform op cost, so the
+        1F1B schedule's measured bubble lands on the analytic
+        (S-1)/(M+S-1). ``loss_grad`` covers the last stage's F AND B,
+        hence 2x the unit cost."""
+
+        def __init__(self, stage):
+            self.stage = stage
+            self.n_stages = S
+            self.microbatches = M
+            self.is_first = stage == 0
+            self.is_last = stage == S - 1
+
+        def place(self, x):
+            return x
+
+        def init_opt_state(self, params):
+            return {"t": np.zeros((), np.float32)}
+
+        def fwd(self, params, x):
+            time.sleep(OP_S)
+            return np.asarray(x, np.float32)
+
+        def loss_grad(self, params, x, y):
+            time.sleep(2 * OP_S)
+            return (np.float32(0.0), {"w": np.zeros((1,), np.float32)},
+                    np.asarray(x, np.float32))
+
+        def bwd(self, params, x, g):
+            time.sleep(OP_S)
+            return ({"w": np.zeros((1,), np.float32)},
+                    np.asarray(g, np.float32))
+
+        def apply_grads(self, params, opt_state, grads):
+            return params, opt_state
+
+    mpmd_dir = tempfile.mkdtemp(prefix="critpath-mpmd-")
+    tr = LocalTransport()
+    stages = [StageWorker(_StubStage(s), {"w": np.zeros((1,), np.float32)},
+                          None, tr) for s in range(S)]
+    tokens = np.zeros((M, 1, 4), np.float32)
+    targets = np.zeros((M, 1, 4), np.float32)
+    errors: dict[int, BaseException] = {}
+
+    def stage_loop(w):
+        try:
+            for step in range(mpmd_steps):
+                w.run_step(
+                    step,
+                    tokens=tokens if w.program.is_first else None,
+                    targets=targets if w.program.is_last else None)
+        except BaseException as e:  # noqa: BLE001 — reraised below
+            errors[w.program.stage] = e
+
+    with recorder_arm(mpmd_dir):
+        ts = [threading.Thread(target=stage_loop, args=(w,), daemon=True,
+                               name=f"critpath-stage-{w.program.stage}")
+              for w in stages]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60.0)
+    if errors:
+        raise next(iter(errors.values()))
+
+    # steady state only (step 0 pays the one-time pipeline fill), and
+    # the per-stage MEDIAN over steps: one descheduled thread must not
+    # skew the receipt on a noisy host
+    online = {s: round(statistics.median(
+        v for k, v in stages[s].bubble_by_step.items() if k >= 1), 6)
+        for s in range(S)}
+    bub = critpath.bubble_fractions(collect.load_merged(mpmd_dir))
+    offline = {}
+    for row in bub["per_step"]:
+        if row["step"] >= 1:
+            offline.setdefault(row["stage"], []).append(row["bubble"])
+    offline = {s: round(statistics.median(v), 6)
+               for s, v in sorted(offline.items())}
+    analytic = (S - 1) / (M + S - 1)
+
+    # the gauge path the fleet console reads: flush the global registry
+    # (run_step set the per-stage gauges) into a live KV, read it back
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    try:
+        tsdb.TimeSeriesFlusher(kv, "critpath-bench").flush()
+        gauge = {}
+        for row in tsdb.read_series(kv, "mpmd.bubble_fraction"):
+            series = row["series"]
+            if "stage=" in series and row["kind"] != "counter":
+                stage = series.split("stage=", 1)[1].rstrip("}")
+                gauge[int(stage)] = float(row["v"])
+        published_series = critpath.publish_profile(kv, prof)
+        cov_gauge = tsdb.latest_value(
+            tsdb.read_series(kv, "critpath.coverage"))
+    finally:
+        kv.close()
+        server.stop()
+    bubble_err = max(abs(v - analytic)
+                     for v in list(online.values()) + list(offline.values()))
+
+    # -- 4. workload export round-trip ---------------------------------------
+    wl = workload.from_trace(merged_ctrl, source="bench critpath ctrl arm")
+    blob = workload.dumps(wl)
+    wl_path = os.path.join(dirs["ctrl"], "workload.json")
+    workload.save(wl, wl_path)
+    roundtrip = workload.dumps(workload.load(wl_path))
+    byte_identical = roundtrip == blob
+
+    top = sorted(prof["segments"].items(), key=lambda kv_: -kv_[1]["total_s"])
+    return {
+        "metric": "critpath",
+        "unit": "attribution coverage / regression gate verdicts / "
+                "bubble fraction",
+        "attribution": {
+            "requests": prof["requests"],
+            "ok": prof["ok"],
+            "coverage_mean": prof["coverage_mean"],
+            "coverage_min": prof["coverage_min"],
+            "frac_requests_ge_95": round(frac_covered, 4),
+            "top_segments": {seg: s["share"] for seg, s in top[:6]},
+        },
+        "tracediff": {
+            "identical_rerun_exit": gate["same"],
+            "slowdown_exit": gate["slow"],
+            "identical_regressions": cmp_same["regressions"],
+            "slowdown_regressions": cmp_slow["regressions"],
+            "decode_ratio": decode_row.get("ratio"),
+        },
+        "bubble": {
+            "stages": S, "microbatches": M, "steps": mpmd_steps,
+            "online_per_stage": online,
+            "offline_per_stage": offline,
+            "gauge_per_stage": gauge,
+            "analytic": round(analytic, 6),
+            "max_abs_err": round(bubble_err, 6),
+        },
+        "workload": {
+            "schema": wl["schema"],
+            "rows": len(wl["requests"]),
+            "byte_identical": bool(byte_identical),
+        },
+        "fleetop_feed": {
+            "series_published": published_series,
+            "coverage_gauge": cov_gauge,
+        },
+        # the tentpole claims
+        "attribution_ok": bool(prof["coverage_mean"]
+                               >= critpath.COVERAGE_TARGET),
+        "gating_ok": bool(gate["slow"] == 1 and gate["same"] == 0
+                          and "decode" in cmp_slow["regressions"]),
+        "bubble_ok": bool(bubble_err <= 0.05),
+        "workload_ok": bool(byte_identical),
+        "_artifacts": {
+            "trace_ctrl": dirs["ctrl"],
+            "trace_slow": dirs["slow"],
+            "trace_mpmd": mpmd_dir,
+        },
+        "source": "measured wall time over the bench_obs modeled fleet "
+                  "(real sockets/queues/engine, sleep-modeled step); "
+                  "tracediff run as the committed CLI on saved profiles; "
+                  "bubble from sleep-modeled 1F1B stage workers vs the "
+                  "trace-derived and analytic fractions",
+    }
+
+
 def bench_health(*, quick: bool = False, seed: int = 0) -> dict:
     """Health-plane receipts: is the durable metrics plane cheap enough
     to leave ON, and does it catch the pathologies fast enough to act?
@@ -3316,12 +3706,39 @@ def _chain_attn(fa, q, k, v, n):
     return out
 
 
+def _emit(result: dict, args) -> None:
+    """Print the one-line round record and, with ``--archive DIR`` (or
+    ``BENCH_ARCHIVE`` in the env), land the run's analysis artifacts —
+    trace dirs, critpath profiles, the workload trace — next to the
+    BENCH_rNN.json the driver commits, so every round's number stays
+    re-derivable from its raw trace. Benches opt in by returning an
+    ``_artifacts`` mapping of name -> file-or-dir; it never appears in
+    the printed record."""
+    import shutil
+
+    artifacts = result.pop("_artifacts", None)
+    line = json.dumps(result)
+    dest = getattr(args, "archive", None) or os.environ.get("BENCH_ARCHIVE")
+    if dest:
+        os.makedirs(dest, exist_ok=True)
+        with open(os.path.join(dest, "result.json"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(line + "\n")
+        for name, path in (artifacts or {}).items():
+            target = os.path.join(dest, name)
+            if os.path.isdir(path):
+                shutil.copytree(path, target, dirs_exist_ok=True)
+            elif os.path.isfile(path):
+                shutil.copy2(path, target)
+    print(line)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--metric",
                    choices=["grad_compress", "overlap", "donation",
                             "cluster", "serve", "serve_slo", "gateway",
-                            "obs", "health", "deploy", "mpmd",
+                            "obs", "health", "deploy", "mpmd", "critpath",
                             "images_per_sec",
                             "allreduce_bw", "pallas",
                             "capacity", "seq_scaling", "lm", "sweep",
@@ -3344,6 +3761,11 @@ def main():
     p.add_argument("--baseline", type=float, default=75.0)
     p.add_argument("--quick", action="store_true",
                    help="tiny CPU config to validate the harness itself")
+    p.add_argument("--archive", default=None, metavar="DIR",
+                   help="also land the run's trace/profile artifacts and "
+                        "result.json under DIR (next to the committed "
+                        "BENCH_rNN.json); BENCH_ARCHIVE in the env does "
+                        "the same")
     p.add_argument("--probe-timeout", type=float,
                    default=float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)),
                    help="seconds to wait for the accelerator before falling "
@@ -3351,51 +3773,56 @@ def main():
     args = p.parse_args()
     if args.metric == "grad_compress":
         # chipless by design (CPU SPMD compile); no accelerator probe
-        print(json.dumps(bench_grad_compress_traffic()))
+        _emit(bench_grad_compress_traffic(), args)
         return
     if args.metric == "overlap":
         # chipless AOT schedule + host-thread stall timing; no probe
-        print(json.dumps(bench_overlap()))
+        _emit(bench_overlap(), args)
         return
     if args.metric == "donation":
         # chipless AOT memory analysis (subprocess-isolated); no probe
-        print(json.dumps(bench_donation()))
+        _emit(bench_donation(), args)
         return
     if args.metric == "cluster":
         # chipless scheduler control-plane timing (stub tenants); no probe
-        print(json.dumps(bench_cluster()))
+        _emit(bench_cluster(), args)
         return
     if args.metric == "serve":
         # chipless serving SLOs (tiny model, CPU backend); no probe.
         # --quick shrinks the trace and skips the AOT donation receipt.
-        print(json.dumps(bench_serve(quick=args.quick)))
+        _emit(bench_serve(quick=args.quick), args)
         return
     if args.metric == "serve_slo":
         # chipless overload/shedding guardrail receipt; no probe
-        print(json.dumps(bench_serve_slo(quick=args.quick)))
+        _emit(bench_serve_slo(quick=args.quick), args)
         return
     if args.metric == "gateway":
         # chipless routing/admission receipt over real sockets; no probe
-        print(json.dumps(bench_gateway(quick=args.quick)))
+        _emit(bench_gateway(quick=args.quick), args)
         return
     if args.metric == "obs":
         # chipless flight-recorder overhead receipt; no probe
-        print(json.dumps(bench_obs(quick=args.quick)))
+        _emit(bench_obs(quick=args.quick), args)
         return
     if args.metric == "health":
         # chipless health-plane overhead + detection-latency receipt
-        print(json.dumps(bench_health(quick=args.quick)))
+        _emit(bench_health(quick=args.quick), args)
+        return
+    if args.metric == "critpath":
+        # chipless trace-analytics receipt: attribution coverage,
+        # tracediff gating, online-vs-offline pipeline bubble; no probe
+        _emit(bench_critpath(quick=args.quick), args)
         return
     if args.metric == "deploy":
         # chipless train->serve deployment receipt; no probe
-        print(json.dumps(bench_deploy(quick=args.quick)))
+        _emit(bench_deploy(quick=args.quick), args)
         return
     if args.metric == "mpmd":
         # chipless MPMD-vs-SPMD pipeline receipt (CPU meshes + per-stage
         # v5e AOT report); no probe. --quick shrinks and skips the AOT.
         mpmd_steps = (20 if args.steps == p.get_default("steps")
                       else args.steps)
-        print(json.dumps(bench_mpmd(steps=mpmd_steps, quick=args.quick)))
+        _emit(bench_mpmd(steps=mpmd_steps, quick=args.quick), args)
         return
     if args.metric != "images_per_sec":
         # probe-timeout 0 means "trust the environment" (same semantics as
@@ -3452,7 +3879,7 @@ def main():
                 f"{result['degraded']}; {fallback}"
                 if "degraded" in result else fallback
             )
-        print(json.dumps(result))
+        _emit(result, args)
         return
     if args.quick:
         result = bench(128, 2, 3, 1, "fp32", True, args.baseline,
@@ -3530,7 +3957,7 @@ def main():
             ),
             image_size=args.image_size, plan=args.plan,
         )
-    print(json.dumps(result))
+    _emit(result, args)
 
 
 if __name__ == "__main__":

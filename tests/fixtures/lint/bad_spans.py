@@ -1,0 +1,26 @@
+"""Seeded GL-O403 violations — span names minted at runtime.
+
+Parsed by the lint tests, never imported. Every function below
+fragments trace aggregation: the critical-path analyzer, waterfalls,
+and tracediff all key on the span name, and each of these mints one
+name per request/value.
+"""
+
+from tpu_sandbox.obs import get_recorder
+
+
+def fstring_no_family(rid):
+    # one span name PER REQUEST — the rid belongs in args=, and an
+    # f-string is only sanctioned with a static "family:" prefix
+    with get_recorder().span(f"request_{rid}"):
+        pass
+
+
+def percent_minted(stage, t0):
+    rec = get_recorder()
+    rec.complete("stage_%d" % stage, t0)
+
+
+def variable_name(event_name):
+    recorder = get_recorder()
+    recorder.instant(event_name, args={"src": "mailbox"})

@@ -1,0 +1,32 @@
+"""Clean twins for GL-O403 — sanctioned span-name shapes.
+
+Static literals (colon families included), the sanctioned
+``f"family:{value}"`` dynamic shape, and same-named methods on
+non-recorder receivers, none of which may trip the rule.
+"""
+
+from tpu_sandbox.obs import get_recorder
+
+
+def static_names(rid, t0):
+    rec = get_recorder()
+    with rec.span("route", args={"rid": rid}):
+        pass
+    rec.complete("swap:pause", t0, args={"ver": 3})
+    rec.instant("lease:expired", args={"rid": rid})
+
+
+def family_prefixed(reason, rid):
+    # the one sanctioned dynamic shape: a static family prefix ending
+    # in ':' — aggregation keys on "door", the reason set is bounded
+    with get_recorder().span(f"door:{reason}", args={"rid": rid}):
+        pass
+
+
+def keyword_name(rid):
+    get_recorder().instant(name="verdict", args={"rid": rid})
+
+
+def non_recorder_receiver(checkpoint, step):
+    # complete()-shaped calls on non-recorder objects are out of scope
+    checkpoint.complete(f"step-{step}", step)

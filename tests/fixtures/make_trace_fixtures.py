@@ -1,0 +1,107 @@
+"""Regenerate the committed trace-dir fixtures.
+
+``trace_small/`` is a deterministic three-process recorder log set —
+client, gateway, one replica — carrying six served requests plus one
+queue-shed, every timestamp hand-placed so tests can assert exact
+segment math. ``trace_slow/`` is its twin with decode modeled 30%
+slower: the pair is the tracediff smoke fixture (small vs slow must
+gate, small vs small must not).
+
+    python tests/fixtures/make_trace_fixtures.py
+
+Writes both directories next to this file. Commit the output; tests
+read the files, they never run this.
+"""
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+PROCS = {
+    "client": 100,
+    "gateway": 200,
+    "serve-rep0": 300,
+}
+
+#: wall - mono offset all three processes share (one box, one clock)
+WALL = 1000.0
+
+
+def _build(decode_scale: float):
+    logs = {proc: [{"ph": "P", "mono": 0.0, "wall": WALL,
+                    "proc": proc, "pid": pid}]
+            for proc, pid in PROCS.items()}
+    counters = {proc: 0 for proc in PROCS}
+
+    def emit(proc, ph, name, ts, dur, trace, parent, args):
+        counters[proc] += 1
+        span = f"{PROCS[proc]:x}.{counters[proc]}"
+        rec = {"ph": ph, "name": name, "ts": round(ts, 6), "trace": trace,
+               "span": span, "parent": parent, "args": args,
+               "pid": PROCS[proc], "proc": proc, "tid": 0}
+        if ph == "X":
+            rec["dur"] = round(dur, 6)
+        logs[proc].append(rec)
+        return span
+
+    for i in range(6):
+        t0 = 0.050 * i
+        rid = f"r{i:02d}"
+        trace = f"t{i:02d}"
+        # per-request deterministic jitter keeps the segment samples
+        # distinct without disturbing the medians tests assert on
+        j = 0.0002 * i
+        decode_dur = (0.020 + 0.0004 * i) * decode_scale
+        sub = emit("client", "X", "submit", t0, 0.0010, trace, None,
+                   {"rid": rid})
+        rt = emit("gateway", "X", "route", t0 + 0.0002, 0.0008, trace, sub,
+                  {"rid": rid, "plen": 20 + i, "chain": "aa11",
+                   "fleet": "default"})
+        enq = emit("gateway", "X", "enqueue", t0 + 0.0010, 0.0002, trace, rt,
+                   {"rid": rid})
+        clm = emit("serve-rep0", "X", "claim", t0 + 0.0030 + j, 0.0005,
+                   trace, enq, {"rid": rid})
+        adm = emit("serve-rep0", "X", "admit", t0 + 0.0036 + j, 0.0040,
+                   trace, clm, {"rid": rid})
+        emit("serve-rep0", "X", "prefill", t0 + 0.0037 + j, 0.0038, trace,
+             adm, {"rid": rid, "plen": 20 + i})
+        t_dec = t0 + 0.0076 + j
+        dec = emit("serve-rep0", "X", "decode", t_dec, decode_dur, trace,
+                   adm, {"rid": rid, "tokens": 8 + i})
+        t_pub = t_dec + decode_dur + 0.0002
+        pub = emit("serve-rep0", "X", "publish", t_pub, 0.0006, trace, dec,
+                   {"rid": rid})
+        emit("serve-rep0", "i", "verdict", t_pub + 0.0007, 0.0, trace, pub,
+             {"rid": rid, "verdict": "ok"})
+
+    # one queue-shed: claimed late off a deep queue, shed at the engine
+    # door — blame must land on queue_wait, the segment that ate it
+    t0, rid, trace = 0.35, "r06", "t06"
+    sub = emit("client", "X", "submit", t0, 0.0010, trace, None,
+               {"rid": rid})
+    rt = emit("gateway", "X", "route", t0 + 0.0002, 0.0008, trace, sub,
+              {"rid": rid, "plen": 20, "chain": "aa11", "fleet": "default"})
+    enq = emit("gateway", "X", "enqueue", t0 + 0.0010, 0.0002, trace, rt,
+               {"rid": rid})
+    clm = emit("serve-rep0", "X", "claim", t0 + 0.0210, 0.0005, trace, enq,
+               {"rid": rid})
+    emit("serve-rep0", "i", "shed:capacity", t0 + 0.0216, 0.0, trace, clm,
+         {"rid": rid, "verdict": "SHED"})
+    return logs
+
+
+def write(dirname: str, decode_scale: float) -> None:
+    out = os.path.join(HERE, dirname)
+    os.makedirs(out, exist_ok=True)
+    for proc, records in _build(decode_scale).items():
+        path = os.path.join(out, f"{proc}-{PROCS[proc]}.jsonl")
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+if __name__ == "__main__":
+    write("trace_small", 1.0)
+    write("trace_slow", 1.3)
+    print("wrote trace_small/ and trace_slow/")

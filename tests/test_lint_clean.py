@@ -160,6 +160,29 @@ def test_clean_metrics_fixture_passes():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_bad_spans_fixture_fires_gl_o403():
+    findings = lint_ctrl(_fixture("bad_spans.py"), "bad_spans.py")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # trips ONLY the span-name rule — three spellings: f-string without a
+    # family prefix, %-formatting, bare variable
+    assert set(by_rule) == {"GL-O403"}
+    assert len(by_rule["GL-O403"]) == 3
+    msgs = "\n".join(f.message for f in by_rule["GL-O403"])
+    assert "span()" in msgs
+    assert "complete()" in msgs
+    assert "instant()" in msgs
+    assert all(f.line > 0 and f.hint for f in findings)
+
+
+def test_clean_spans_fixture_passes():
+    # static literals, colon families, the sanctioned f"family:{value}"
+    # shape, keyword name=, and non-recorder receivers all stay silent
+    findings = lint_ctrl(_fixture("clean_spans.py"), "clean_spans.py")
+    assert findings == [], [f.format() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # Pass 2 fixtures (pure layers; the compile layer runs in the subprocess
 # gate below)

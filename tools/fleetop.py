@@ -6,6 +6,9 @@ Connects to the cluster KV store and renders, from durable state alone
 
 - fleet health gauges from the tsdb ring (queue depth, replica count,
   goodput, recorder drops) and the per-series producer list;
+- the where-time-goes panel: the live critical-path segment breakdown
+  published by ``obs/critpath.publish_profile`` and the per-stage MPMD
+  ``mpmd.bubble_fraction`` gauges, when either is present;
 - per-replica occupancy and SLO burn: the TTL'd load reports next to
   each replica's shed/done burn rate over the recent window, with
   replicas currently excluded from routing (active ``replica_burn``)
@@ -69,6 +72,62 @@ def _burn_by_proc(kv) -> dict[str, tuple[float, float, float | None]]:
         rate = s / (s + d) if s + d > 0 else None
         out[proc] = (s, d, rate)
     return out
+
+
+def _series_labels(series: str) -> dict[str, str]:
+    """``name{seg=route,proc=x}`` -> {"seg": "route", ...}."""
+    if "{" not in series or not series.endswith("}"):
+        return {}
+    body = series[series.index("{") + 1:-1]
+    out = {}
+    for pair in body.split(","):
+        if "=" in pair:
+            k, v = pair.split("=", 1)
+            out[k] = v
+    return out
+
+
+def _latest_by_label(rows: list[dict], label: str) -> dict[str, float]:
+    """Newest gauge value per distinct value of ``label`` across all
+    producers (fleet-wide view: last writer wins per label value)."""
+    best: dict[str, dict] = {}
+    for r in rows:
+        if r["kind"] == "counter":
+            continue
+        key = _series_labels(r["series"]).get(label, "?")
+        cur = best.get(key)
+        if cur is None or r["bucket"] >= cur["bucket"]:
+            best[key] = r
+    return {k: float(v["v"]) for k, v in best.items()}
+
+
+def _critpath_panel(kv, lines) -> None:
+    """Where time goes: the live segment breakdown published by
+    ``obs/critpath.publish_profile`` plus the per-stage pipeline bubble
+    the MPMD stage workers publish online."""
+    shares = _latest_by_label(
+        tsdb.read_series(kv, "critpath.segment.share"), "seg")
+    bubbles = _latest_by_label(
+        tsdb.read_series(kv, "mpmd.bubble_fraction"), "stage")
+    if not shares and not bubbles:
+        return
+    lines.append("")
+    lines.append("where time goes:")
+    if shares:
+        ms = _latest_by_label(
+            tsdb.read_series(kv, "critpath.segment.ms"), "seg")
+        for seg, share in sorted(shares.items(), key=lambda kv_: -kv_[1]):
+            med = ms.get(seg)
+            lines.append(
+                f"  {seg:<14} {share:>6.1%} of request wall"
+                + ("" if med is None else f"   median {med:.3f}ms"))
+        cov = tsdb.latest_value(tsdb.read_series(kv, "critpath.coverage"))
+        if cov is not None:
+            lines.append(f"  attribution coverage {float(cov):.1%}")
+    if bubbles:
+        lines.append("  mpmd bubble: " + "  ".join(
+            f"stage{stage}={frac:.3f}"
+            for stage, frac in sorted(bubbles.items())))
 
 
 def _deploy_panel(kv, reports, lines, now) -> None:
@@ -186,6 +245,8 @@ def render(kv, *, now: float | None = None, max_alerts: int = 8) -> str:
                 f"{_fmt_num(rep.get('active')):>7} {_fmt_num(s):>6} "
                 f"{_fmt_num(d):>6} "
                 f"{('-' if rate is None else f'{rate:.1%}'):>7}  {routing}")
+
+    _critpath_panel(kv, lines)
 
     _deploy_panel(kv, reports, lines, now)
 

@@ -12,15 +12,24 @@ KV-sequencer calibration, and renders one of:
 
     python tools/tracecat.py LOGDIR --rid r0007
         Per-request waterfall: every span of that request's trace,
-        ordered and indented by causal depth.
+        ordered and indented by causal depth. Spans on the request's
+        critical path are marked ``*``; spans whose parent never landed
+        (leaked span, torn log) carry an ``[orphan]`` tag. A where-did-
+        the-time-go segment line follows the waterfall.
+
+    python tools/tracecat.py LOGDIR --critpath [FILE]
+        Run-level critical-path profile: where the run's request time
+        went, segment by segment (obs/critpath.py). With FILE, also
+        write the profile JSON — the input ``tools/tracediff.py`` gates
+        on.
 
     python tools/tracecat.py LOGDIR --last 10s
         Postmortem: causally-ordered text timeline of the final N
         seconds before the logs went quiet — kills, lease expiries,
         scavenge requeues, in order, across every process.
 
-With no mode flag it prints a summary: processes, record counts, trace
-chains and their integrity.
+With no mode flag it prints a summary: processes, record counts,
+dropped (torn/corrupt) lines, trace chains and their integrity.
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_sandbox.obs import collect  # noqa: E402
+from tpu_sandbox.obs import collect, critpath  # noqa: E402
 
 
 def _parse_seconds(text: str) -> float:
@@ -52,12 +61,17 @@ def main(argv=None) -> int:
                     help="print the waterfall for one request id")
     ap.add_argument("--trace", metavar="TRACE_ID",
                     help="print the waterfall for one trace id")
+    ap.add_argument("--critpath", metavar="FILE", nargs="?", const="-",
+                    help="print the run's critical-path profile; with "
+                         "FILE, also write the profile JSON for "
+                         "tracediff")
     ap.add_argument("--last", metavar="DUR",
                     help="print the postmortem timeline of the final "
                          "window, e.g. --last 10s")
     args = ap.parse_args(argv)
 
-    logs = collect.load_dir(args.logdir)
+    stats: dict = {}
+    logs = collect.load_dir(args.logdir, stats)
     if not logs:
         print(f"no recorder logs under {args.logdir}", file=sys.stderr)
         return 1
@@ -78,7 +92,29 @@ def main(argv=None) -> int:
         if not rows:
             print("no matching trace", file=sys.stderr)
             return 1
-        print(collect.format_waterfall(rows))
+        trace_id = rows[0]["trace"]
+        records = [r for r in merged if r.get("trace") == trace_id]
+        crit = {r.get("span") for r in critpath.critical_path(records)
+                if r.get("span")}
+        print(collect.format_waterfall(rows, crit=crit))
+        stalls = [r for r in merged if r.get("ph") == "X"
+                  and r.get("name", "").startswith("swap:")]
+        req = critpath.attribute_request(records, stalls)
+        if req is not None:
+            segs = sorted(req["segments"].items(), key=lambda kv: -kv[1])
+            print(f"  critical path ({req['outcome']}, "
+                  f"wall {req['wall_s'] * 1e3:.3f}ms, coverage "
+                  f"{req['coverage']:.1%}): " + ", ".join(
+                      f"{seg}={s * 1e3:.3f}ms" for seg, s in segs))
+            if req["outcome"] != "ok" and req.get("blame"):
+                print(f"  blame: {req['blame']}")
+        did_something = True
+    if args.critpath:
+        result = critpath.analyze(merged)
+        print(critpath.format_profile(result["profile"]))
+        if args.critpath != "-":
+            critpath.save_profile(result["profile"], args.critpath)
+            print(f"wrote profile to {args.critpath}")
         did_something = True
     if args.last:
         window = collect.last_window(merged, _parse_seconds(args.last))
@@ -86,7 +122,8 @@ def main(argv=None) -> int:
         did_something = True
 
     if not did_something:
-        print(f"{len(logs)} process logs, {len(merged)} records")
+        print(f"{len(logs)} process logs, {len(merged)} records, "
+              f"{stats.get('dropped_records', 0)} dropped lines")
         for key in sorted(logs):
             print(f"  {key}: {len(logs[key])} records "
                   f"(offset {offsets.get(key, 0.0):+.6f}s)")

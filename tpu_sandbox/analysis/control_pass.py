@@ -1,7 +1,7 @@
 """Pass 3: control-plane lint over ``runtime/``, ``serve/``,
 ``gateway/``, ``obs/`` and ``deploy/`` (AST).
 
-Eight rules distilled from this repo's own elastic-runtime and serving
+Nine rules distilled from this repo's own elastic-runtime and serving
 incident history:
 
 - **GL-R301** — ``kv.add(key, 1) == 1`` claims whose key carries no
@@ -55,6 +55,17 @@ incident history:
   mints one series per distinct value: unbounded cardinality in every
   snapshot, scrape, and tsdb flush, and nothing stable for alert rules
   to key on. Bounded dimensions belong in ``labels=``.
+- **GL-O403** — a ``span()``/``begin_span()``/``complete()``/
+  ``instant()`` call on a recorder whose name argument is minted at
+  runtime (``%``, ``.format()``, concatenation, a bare variable, or an
+  f-string with no static family prefix). Span names are the
+  aggregation key for the critical-path analyzer, waterfalls, and
+  trace-diff gating — unbounded names fragment every one of them. The
+  one sanctioned dynamic shape is ``f"family:{value}"`` with a static
+  family prefix ending in ``:`` (``door:{reason}``, ``shed:{reason}``,
+  ``fault:{action}``): downstream aggregation keys on the family, and
+  the tail must come from a bounded set. Request-sized dimensions
+  (rid, step) belong in ``args=``.
 """
 
 from __future__ import annotations
@@ -89,6 +100,19 @@ METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
 #: the sanctioned metric-name shape: lowercase snake segments joined by
 #: dots, at least two segments ("component.metric")
 METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+#: span/event emitters on a recorder (GL-O403). ``metric`` is excluded:
+#: the tsdb flusher relays registry names already policed by GL-O402
+SPAN_EMITTERS = frozenset({"span", "begin_span", "complete", "instant"})
+
+#: a static span name: lowercase snake/dotted segments, optionally
+#: colon-joined into a family ("claim", "door:no_replicas", "swap:pause")
+SPAN_NAME_RE = re.compile(
+    r"^[a-z0-9_]+(\.[a-z0-9_]+)*(:[a-z0-9_]+(\.[a-z0-9_]+)*)*$")
+
+#: the static family prefix an f-string span name must open with to be
+#: sanctioned: f"door:{reason}" aggregates as "door"
+SPAN_FAMILY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*:$")
 
 
 #: nested scopes a statement walk must not descend into — each is
@@ -531,6 +555,56 @@ class _FnLinter:
                 f"value (put bounded dimensions in labels=)",
             )
 
+    # -- GL-O403 -------------------------------------------------------------
+
+    @staticmethod
+    def _is_recorder_receiver(node: ast.AST) -> bool:
+        """``get_recorder().x``, ``rec.x``, ``self._recorder.x`` —
+        anything that reads as "the recorder". Same-named methods on
+        other objects (a checkpoint's ``complete``, say) are out of
+        scope."""
+        if isinstance(node, ast.Call):
+            return _final_attr(node.func) == "get_recorder"
+        name = _final_attr(node)
+        if name is None:
+            return False
+        low = name.lstrip("_").lower()
+        return low == "rec" or "recorder" in low
+
+    @staticmethod
+    def _span_name_ok(name_arg: ast.AST) -> bool:
+        if isinstance(name_arg, ast.Constant):
+            return isinstance(name_arg.value, str) \
+                and bool(SPAN_NAME_RE.match(name_arg.value))
+        if isinstance(name_arg, ast.JoinedStr) and name_arg.values:
+            head = name_arg.values[0]
+            return isinstance(head, ast.Constant) \
+                and isinstance(head.value, str) \
+                and bool(SPAN_FAMILY_RE.match(head.value))
+        return False
+
+    def _check_span_names(self, fn: ast.AST) -> None:
+        """Span names must be static literals (or family-prefixed
+        f-strings); everything downstream aggregates by span name."""
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SPAN_EMITTERS
+                    and self._is_recorder_receiver(node.func.value)):
+                continue
+            name_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            if name_arg is None or self._span_name_ok(name_arg):
+                continue
+            self._emit(
+                "GL-O403", node,
+                f"{node.func.attr}() span name is minted at runtime — "
+                f"trace aggregation keys on span names; use a static "
+                f"literal or f\"family:{{value}}\" with a static family "
+                f"prefix, and put request-sized dimensions in args=",
+            )
+
     # -- GL-R304 (per-class, run separately) ---------------------------------
 
     def run_common(self, fn: ast.AST) -> None:
@@ -545,6 +619,7 @@ class _FnLinter:
         self._check_unbounded_queues(fn)
         self._check_span_leaks(fn)
         self._check_metric_names(fn)
+        self._check_span_names(fn)
 
 
 def _base_label(expr: ast.AST) -> str | None:

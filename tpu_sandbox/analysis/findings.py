@@ -138,6 +138,16 @@ RULES: dict[str, tuple[str, str]] = {
         "'component.metric' literal and carry the bounded dimension in "
         "labels= (see obs/metrics.py)",
     ),
+    "GL-O403": (
+        "span name is minted at runtime",
+        "a span/instant name built with %, .format(), concatenation, or "
+        "a bare variable has unbounded cardinality — the critical-path "
+        "analyzer, waterfalls, and trace-diff gating all aggregate by "
+        "span name and fragment across it; use a static literal, or the "
+        "sanctioned f'family:{value}' shape (static family prefix ending "
+        "in ':') which downstream aggregation keys on, with the value "
+        "drawn from a bounded set",
+    ),
 }
 
 

@@ -366,6 +366,13 @@ class Gateway:
         t_route = time.monotonic()
         self._refresh(fleet)
         chain = chain_digest(prompt, fleet.spec.block_size)
+        # workload-trace riders on the route span: enough to replay this
+        # request against a twin (obs/workload.py) without the payload
+        route_args = {"rid": rid, "plen": len(prompt),
+                      "chain": str(chain[-1]) if chain else "",
+                      "fleet": fleet.spec.name or "default"}
+        if deadline_s is not None:
+            route_args["deadline_s"] = round(deadline_s, 6)
         views = routing.fresh(self._views(fleet), self.max_report_age_s)
         if fleet.shares:
             # canary split: draw a version by share, route within the
@@ -395,7 +402,7 @@ class Gateway:
                 # the same claim-once verdict slot as door:infeasible.
                 route_ctx = rec.complete(
                     "route", t_route, parent=body.get("tc"),
-                    args={"rid": rid, "routed": "none"})
+                    args={**route_args, "routed": "none"})
                 with rec.span("door:no_replicas", parent=route_ctx,
                               args={"rid": rid}):
                     self._door_shed(fleet, rid, "no_replicas", 0.0)
@@ -406,7 +413,7 @@ class Gateway:
             # based): admit to the shared queue — a warming-up fleet will
             # claim it, and engine-side guardrails still apply
             route_ctx = rec.complete("route", t_route, parent=body.get("tc"),
-                                     args={"rid": rid, "routed": "shared"})
+                                     args={**route_args, "routed": "shared"})
             with rec.span("enqueue", parent=route_ctx,
                           args={"rid": rid}) as sp:
                 self._enqueue_request(fleet, body, rid, prompt, max_new,
@@ -422,7 +429,7 @@ class Gateway:
             deadline_s=deadline_s,
             occupancy_bound=fleet.spec.occupancy_bound)
         route_ctx = rec.complete("route", t_route, parent=body.get("tc"),
-                                 args={"rid": rid, "replica": view.tag})
+                                 args={**route_args, "replica": view.tag})
         if not ok:
             # the trace's terminal span for a door shed: door:<reason>
             with rec.span(f"door:{reason}", parent=route_ctx,

@@ -41,6 +41,8 @@ from tpu_sandbox.mpmd.program import (
 )
 from tpu_sandbox.mpmd.schedule import bubble_fraction, one_f_one_b
 from tpu_sandbox.mpmd.transport import EdgeNames, LocalTransport
+from tpu_sandbox.obs.metrics import get_registry
+from tpu_sandbox.obs.record import get_recorder
 from tpu_sandbox.train.checkpoint import HostCheckpoint
 
 
@@ -85,6 +87,10 @@ class StageWorker:
         self.next_step = 0
         self.losses: dict[int, float] = {}
         self.step_seconds: dict[int, float] = {}
+        #: step -> measured bubble fraction (1 - compute/wall); the same
+        #: number is published online as the ``mpmd.bubble_fraction``
+        #: gauge and derivable offline from the stage:op/stage:step spans
+        self.bubble_by_step: dict[int, float] = {}
         self.applied_steps: list[int] = []
         #: (step, op_index) at which to raise StageKilled — fault hook
         self.fail_at: tuple[int, int] | None = None
@@ -128,7 +134,15 @@ class StageWorker:
         stash: dict[int, object] = {}
         per_mb: dict[int, object] = {}
         loss = np.float32(0.0)
+        # bubble accounting: "stage:wait" spans bracket the blocking
+        # transport gets, "stage:op" spans bracket stage compute, and the
+        # closing "stage:step" span carries the measured bubble — all
+        # constant span names (GL-O403) with stage/step/mb riding args
+        rec = get_recorder()
+        s = prog.stage
+        compute_s = 0.0
         t0 = time.perf_counter()
+        t_step = time.monotonic()
         for idx, (op, m) in enumerate(self.ops):
             self._maybe_fail(step, idx)
             if self.on_op is not None:
@@ -137,37 +151,72 @@ class StageWorker:
                 if prog.is_first:
                     x = prog.place(np.asarray(tokens_mb[m]))
                 else:
+                    t_wait = time.monotonic()
                     self._consume(self.act_in, step, m)
                     (h,) = tr.get(self.act_in, step, m,
                                   timeout=self.get_timeout)
+                    rec.complete("stage:wait", t_wait,
+                                 args={"stage": s, "step": step,
+                                       "op": "F", "mb": m})
                     x = prog.place(h)
                 stash[m] = x
                 if not prog.is_last:
+                    t_op = time.monotonic()
                     h_out = prog.fwd(self.params, x)
+                    compute_s += time.monotonic() - t_op
+                    rec.complete("stage:op", t_op,
+                                 args={"stage": s, "step": step,
+                                       "op": "F", "mb": m})
                     tr.put(self.act_out, step, m, [np.asarray(h_out)])
             else:
                 if prog.is_last:
+                    t_op = time.monotonic()
                     lv, gp, gh = prog.loss_grad(
                         self.params, stash.pop(m),
                         prog.place(np.asarray(targets_mb[m])))
+                    compute_s += time.monotonic() - t_op
+                    rec.complete("stage:op", t_op,
+                                 args={"stage": s, "step": step,
+                                       "op": "B", "mb": m})
                     # ship the upstream cotangent before anything else:
                     # the previous stage is waiting on it
                     tr.put(self.grad_out, step, m, [np.asarray(gh)])
                     loss = loss + np.float32(lv)
                     per_mb[m] = jax.tree.map(np.asarray, gp)
                 else:
+                    t_wait = time.monotonic()
                     self._consume(self.grad_in, step, m)
                     (g,) = tr.get(self.grad_in, step, m,
                                   timeout=self.get_timeout)
+                    rec.complete("stage:wait", t_wait,
+                                 args={"stage": s, "step": step,
+                                       "op": "B", "mb": m})
+                    t_op = time.monotonic()
                     gp, gx = prog.bwd(self.params, stash.pop(m),
                                       prog.place(g))
+                    compute_s += time.monotonic() - t_op
+                    rec.complete("stage:op", t_op,
+                                 args={"stage": s, "step": step,
+                                       "op": "B", "mb": m})
                     if not prog.is_first:
                         tr.put(self.grad_out, step, m, [np.asarray(gx)])
                     per_mb[m] = jax.tree.map(np.asarray, gp)
         grads = accumulate_descending(per_mb)
+        t_op = time.monotonic()
         self.params, self.opt_state = prog.apply_grads(
             self.params, self.opt_state, prog.place(grads))
-        self.step_seconds[step] = time.perf_counter() - t0
+        compute_s += time.monotonic() - t_op
+        rec.complete("stage:op", t_op,
+                     args={"stage": s, "step": step, "op": "A", "mb": -1})
+        wall = time.perf_counter() - t0
+        self.step_seconds[step] = wall
+        bubble = max(0.0, 1.0 - compute_s / wall) if wall > 0 else 0.0
+        self.bubble_by_step[step] = bubble
+        get_registry().gauge("mpmd.bubble_fraction",
+                             labels={"stage": str(s)}).set(round(bubble, 6))
+        rec.complete("stage:step", t_step,
+                     args={"stage": s, "step": step,
+                           "bubble": round(bubble, 6)})
         if prog.is_last:
             self.losses[step] = float(loss)
         self.applied_steps.append(step)

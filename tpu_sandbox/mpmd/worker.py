@@ -182,6 +182,14 @@ def main(argv=None) -> int:
 
     worker.on_op = on_op
 
+    # -- online bubble publication -------------------------------------------
+    # run_step sets the mpmd.bubble_fraction gauge per step; flushing the
+    # registry through the tsdb ring after every step makes it durable,
+    # so the health plane can rule on it and fleetop renders it live
+    from tpu_sandbox.obs.tsdb import TimeSeriesFlusher
+    flusher = TimeSeriesFlusher(
+        kv, proc=f"mpmd-{args.pipeline}-s{stage}".replace("/", "-"))
+
     # -- the training loop ---------------------------------------------------
     edges = ([EdgeNames(i).act for i in range(n_stages - 1)]
              + [EdgeNames(i).grad for i in range(n_stages - 1)])
@@ -191,6 +199,7 @@ def main(argv=None) -> int:
             step,
             tokens=tokens if program.is_first else None,
             targets=targets if program.is_last else None)
+        flusher.flush()
         worker.save_checkpoint(step)
         kv.set(f"{prefix}/ckpt/{stage}", str(step))
         if program.is_last:

@@ -23,6 +23,16 @@ Five pieces, deliberately decoupled:
   logs on a KV-sequencer-calibrated clock, emits Chrome trace-event JSON
   (spans + metric counter tracks), per-request waterfalls, and
   last-N-seconds postmortem timelines (``tools/tracecat.py`` is the CLI).
+- :mod:`tpu_sandbox.obs.critpath` — the trace analytics plane over the
+  merged timeline: per-request causal critical paths attributed to named
+  segments (>= 95% of wall, residue reported as ``unattributed``), the
+  run-level where-time-goes profile, blame for every shed/late request,
+  offline MPMD bubble accounting, and the profile compare engine behind
+  ``tools/tracediff.py`` regression gating.
+- :mod:`tpu_sandbox.obs.workload` — the canonical replayable workload
+  trace exported from a merged run (arrival offsets, tenant, prefix
+  chain, token counts, outcome), schema-versioned and byte-stable so a
+  saved workload round-trips and diffs cleanly.
 """
 
 from tpu_sandbox.obs.record import (ENV_TRACE_DIR, Recorder, TraceContext,

@@ -33,11 +33,15 @@ import statistics
 
 # -- loading ------------------------------------------------------------------
 
-def read_log(path: str) -> list[dict]:
+def read_log(path: str, stats: dict | None = None) -> list[dict]:
     """Parse one recorder JSONL file. A torn final line (the process was
     SIGKILLed mid-write) is dropped, not fatal — postmortems read logs
-    from processes that died badly."""
+    from processes that died badly. Pass a ``stats`` dict to have every
+    skipped line counted under ``dropped_records``: a postmortem that
+    silently loses records reads as "nothing happened here", which is
+    exactly the wrong story to tell about a process that died mid-write."""
     records = []
+    dropped = 0
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -46,20 +50,30 @@ def read_log(path: str) -> list[dict]:
             try:
                 rec = json.loads(line)
             except ValueError:
+                dropped += 1
                 continue
             if isinstance(rec, dict):
                 records.append(rec)
+            else:
+                dropped += 1
+    if stats is not None:
+        stats["dropped_records"] = stats.get("dropped_records", 0) + dropped
     return records
 
 
-def load_dir(logdir: str) -> dict[str, list[dict]]:
+def load_dir(logdir: str,
+             stats: dict | None = None) -> dict[str, list[dict]]:
     """Read every ``*.jsonl`` under ``logdir``, keyed by process key
-    (``proc/pid`` — distinct even when two processes share a name)."""
+    (``proc/pid`` — distinct even when two processes share a name).
+    ``stats`` (optional) accumulates ``files`` and ``dropped_records``
+    across the whole directory."""
     logs: dict[str, list[dict]] = {}
     for name in sorted(os.listdir(logdir)):
         if not name.endswith(".jsonl"):
             continue
-        for rec in read_log(os.path.join(logdir, name)):
+        if stats is not None:
+            stats["files"] = stats.get("files", 0) + 1
+        for rec in read_log(os.path.join(logdir, name), stats):
             key = f"{rec.get('proc', '?')}/{rec.get('pid', 0)}"
             logs.setdefault(key, []).append(rec)
     return logs
@@ -121,8 +135,8 @@ def merge(logs: dict[str, list[dict]],
     return merged
 
 
-def load_merged(logdir: str) -> list[dict]:
-    logs = load_dir(logdir)
+def load_merged(logdir: str, stats: dict | None = None) -> list[dict]:
+    logs = load_dir(logdir, stats)
     return merge(logs, clock_offsets(logs))
 
 
@@ -246,6 +260,7 @@ def request_waterfall(merged: list[dict], *, rid: str | None = None,
     if not records:
         return []
     depths = _chain_depths(records)
+    span_ids = {r.get("span") for r in records if r.get("span")}
     base = min(r["uts"] for r in records)
     rows = []
     for r in records:
@@ -256,6 +271,13 @@ def request_waterfall(merged: list[dict], *, rid: str | None = None,
             "proc": r.get("pkey", "?"),
             "name": r.get("name", "?"),
             "ph": r["ph"],
+            "span": r.get("span"),
+            # a parent that never landed in the trace (the parent span
+            # leaked, or its log tail was torn off with the process):
+            # the row renders at depth 0 but says WHY, instead of
+            # impersonating a root
+            "orphan": bool(r.get("parent")) and r.get("parent")
+            not in span_ids,
             "args": {k: v for k, v in (r.get("args") or {}).items()
                      if k != "rid"},
             "trace": trace,
@@ -264,7 +286,11 @@ def request_waterfall(merged: list[dict], *, rid: str | None = None,
     return rows
 
 
-def format_waterfall(rows: list[dict]) -> str:
+def format_waterfall(rows: list[dict],
+                     crit: set[str] | None = None) -> str:
+    """Render waterfall rows; spans whose id is in ``crit`` (the
+    critical-path span set from ``obs/critpath.py``) get a ``*`` prefix,
+    orphaned rows an explicit ``[orphan]`` tag."""
     lines = []
     if rows:
         lines.append(f"trace {rows[0]['trace']}")
@@ -272,8 +298,11 @@ def format_waterfall(rows: list[dict]) -> str:
         mark = "·" if row["ph"] == "i" else \
             f"{row['dur'] * 1e3:8.3f}ms"
         indent = "  " * row["depth"]
+        star = "*" if crit and row.get("span") in crit else " "
+        orphan = "  [orphan]" if row.get("orphan") else ""
         lines.append(f"  +{row['t'] * 1e3:9.3f}ms {mark:>10} "
-                     f"{indent}{row['name']}  [{row['proc']}]")
+                     f"{star}{indent}{row['name']}  [{row['proc']}]"
+                     f"{orphan}")
     return "\n".join(lines)
 
 

@@ -199,11 +199,19 @@ class _EngineBase:
         slots keep decoding on the version they pinned at admit (grouped
         decode), only the prefix cache is flushed — its K/V was computed
         under other weights. Returns the number of cache entries flushed."""
+        t_swap = time.monotonic()
         self._params_by_ver[int(version)] = params
         self.version = int(version)
         flushed = self.cache.flush_prefix_cache()
         self._gc_params()
         get_registry().counter("engine.swap").inc()
+        # process-level span (no request parent): the critpath analyzer
+        # overlaps it against resident requests' gaps — a swap stalls
+        # every request on this engine, and that stall should be blamed
+        # on the swap, not on "queue_wait"
+        get_recorder().complete("swap:pause", t_swap,
+                                args={"ver": int(version),
+                                      "flushed": int(flushed)})
         return flushed
 
     def has_version(self, ver: int) -> bool:
@@ -403,6 +411,7 @@ class _EngineBase:
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :plen] = request.prompt
         dest = self.cache.dest_indices(alloc, bucket).astype(np.int32)
+        t_prefill = time.monotonic()
         next_logits, self.k_pages, self.v_pages = self.step_fns.prefill[bucket](
             params, self.k_pages, self.v_pages,
             jnp.asarray(toks), jnp.asarray(dest),
@@ -411,10 +420,15 @@ class _EngineBase:
         self.cache.commit_prefix(alloc)
         slot = _Slot(request=request, alloc=alloc, tokens=list(request.prompt),
                      preemptions=request.preemptions, ver=ver)
-        # the admit span covers the prefill compute; the decode span that
-        # follows is emitted retrospectively at retire time, anchored here
+        # the admit span covers admission bookkeeping plus the prefill
+        # compute; the prefill child span carves the compute out so the
+        # critpath analyzer can tell "slow admission" from "big prompt".
+        # The decode span that follows is emitted retrospectively at
+        # retire time, anchored here
         ctx = get_recorder().complete("admit", t_admit, parent=request.tc,
                                       args={"rid": request.rid})
+        get_recorder().complete("prefill", t_prefill, parent=ctx,
+                                args={"rid": request.rid, "plen": plen})
         slot.tc = None if ctx is None else ctx.to_wire()
         slot.admitted_mono = time.monotonic()
         self.slots[slot_idx] = slot

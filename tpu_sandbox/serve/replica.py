@@ -614,26 +614,35 @@ class ReplicaWorker:
                         args={"rid": rid, "ran": getattr(res, "ver", 0),
                               "pin": int(pin_raw)})
                 continue
-            # the verdict INSTANT is trace-only; the verdict BODY below is
-            # untouched, so bitwise-identical republication still holds
-            get_recorder().instant(
-                "verdict", parent=getattr(res, "tc", None),
-                args={"rid": rid, "verdict": "ok"})
+            # the publish SPAN and verdict INSTANT are trace-only; the
+            # verdict BODY below is untouched, so bitwise-identical
+            # republication still holds
+            t_pub = time.monotonic()
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "ok", "tokens": res.tokens,
                 "preemptions": res.preemptions, "replica": self.tag,
                 "ver": int(getattr(res, "ver", 0)),
                 "ttft_s": round(res.ttft, 6)})
+            pub_ctx = get_recorder().complete(
+                "publish", t_pub, parent=getattr(res, "tc", None),
+                args={"rid": rid})
+            get_recorder().instant(
+                "verdict", parent=pub_ctx,
+                args={"rid": rid, "verdict": "ok"})
             self.stats.completed += 1
         for rid, rec in self.engine.shed.items():
             if rid in self._published:
                 continue
-            get_recorder().instant(
-                "verdict", parent=getattr(rec, "tc", None),
-                args={"rid": rid, "verdict": "SHED"})
+            t_pub = time.monotonic()
             self._publish_verdict(rid, {
                 "rid": rid, "verdict": "SHED", "reason": rec.reason,
                 "preemptions": rec.preemptions, "replica": self.tag})
+            pub_ctx = get_recorder().complete(
+                "publish", t_pub, parent=getattr(rec, "tc", None),
+                args={"rid": rid})
+            get_recorder().instant(
+                "verdict", parent=pub_ctx,
+                args={"rid": rid, "verdict": "SHED"})
             self.stats.shed += 1
 
     def _publish_verdict(self, rid: str, body: dict) -> None:
