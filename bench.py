@@ -1541,6 +1541,369 @@ def bench_gateway(*, n_requests: int = 96, replicas: int = 3,
     }
 
 
+def bench_chaos(*, quick: bool = False, seed: int = 0) -> dict:
+    """HA front-door receipts: seeded chaos campaigns against a real
+    multi-gateway fleet, TLS on every external wire.
+
+    **Zero-loss under SIGKILL** — N real gateway *processes* (the
+    ``python -m tpu_sandbox.gateway`` entrypoint, TLS certs from
+    tests/fixtures/tls, shared-secret hello inside the channel) front a
+    replica-thread fleet; a seeded campaign replays a canonical workload
+    trace (obs/workload) and SIGKILLs the connected gateway mid-load.
+    Claim: the failover client loses zero requests, every rid reaches
+    exactly one terminal verdict (claim audit), and the failover cost is
+    visible in submit p99 but bounded.
+
+    **Seeded matrix** — >= 3 distinct seeded campaigns drawn by
+    runtime/chaos.build_schedule over the gateway-kill / shed-storm /
+    replica-stall families, each ending green on the same invariants;
+    one seed replayed against a fresh fleet must produce a byte-identical
+    claim audit (the determinism receipt).
+
+    **Tracediff gate** — the SIGKILL campaign's critical-path profile is
+    gated by tools/tracediff.py against a fault-free control over the
+    same trace: losing a gateway may cost availability blips at the
+    door, but the per-request serve path (prefill/decode/queue) must not
+    regress.
+
+    Honesty note: replicas are in-process threads over the real engine
+    with a sleep-modeled step (bench_gateway's stub); gateways are real
+    processes and the SIGKILL is a real ``os.kill``. The wire is TLS end
+    to end — a plaintext connect must be refused with a clean close and
+    show up in the surviving gateway's handshake-failure counter.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import contextlib
+    import signal as _signal
+    import socket as _socket
+    import struct
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from tpu_sandbox.gateway import (FleetSpec, GatewayClient,
+                                     make_client_ssl_context)
+    from tpu_sandbox.gateway import wire as gwire
+    from tpu_sandbox.gateway.server import live_gateway_endpoints
+    from tpu_sandbox.models.transformer import TransformerConfig
+    from tpu_sandbox.obs import (ENV_TRACE_DIR, collect, critpath,
+                                 get_recorder, reset_recorder, workload)
+    from tpu_sandbox.runtime.chaos import (ChaosCampaign, ChaosFault,
+                                           build_schedule,
+                                           check_alert_claims, prefix_probe)
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+    from tpu_sandbox.serve.cache import CacheConfig, chain_digest
+    from tpu_sandbox.serve.engine import ContinuousEngine, ServeConfig
+    from tpu_sandbox.serve.replica import ReplicaWorker, read_load_reports
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tlsdir = os.path.join(repo, "tests", "fixtures", "tls")
+    cert = os.path.join(tlsdir, "server.pem")
+    key = os.path.join(tlsdir, "server.key")
+    ca = os.path.join(tlsdir, "ca.pem")
+    TOKEN = "bench-chaos-secret"
+
+    n_gateways = 2 if quick else 3
+    n_replicas = 2 if quick else 3
+    # moderate utilization: the gate compares per-request serve segments
+    # ctrl-vs-kill, which only pairs cleanly when arrivals don't saturate
+    # the host (post-failover bunching would deepen batches and inflate
+    # every segment on a loaded box)
+    n_requests = 12 if quick else 48
+    duration_s = 0.8 if quick else 4.0
+    matrix_seeds = [seed + 11, seed + 22] if quick \
+        else [seed + 11, seed + 22, seed + 33]
+
+    BLOCK = 8
+    PREFILL_TOKEN_S = 0.4e-3
+    DECODE_STEP_S = 0.8e-3
+    mcfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=128)
+    ccfg = CacheConfig(num_blocks=64, block_size=BLOCK, max_blocks_per_seq=8)
+
+    class _ModeledStep:
+        buckets = (32,)
+        vocab = 64
+
+        def __init__(self):
+            self.prefill = {b: self._prefill for b in self.buckets}
+
+        def pick_bucket(self, plen):
+            for b in self.buckets:
+                if plen <= b:
+                    return b
+            raise ValueError(f"prompt of {plen} exceeds {self.buckets}")
+
+        def _prefill(self, params, k, v, toks, dest, last):
+            time.sleep(PREFILL_TOKEN_S
+                       * int(np.count_nonzero(np.asarray(dest))))
+            toks = np.asarray(toks)
+            logits = np.zeros((self.vocab,), np.float32)
+            logits[(int(toks[0, int(last)]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+        def decode(self, params, k, v, tokens, lengths, tables):
+            time.sleep(DECODE_STEP_S)
+            tokens = np.asarray(tokens)
+            logits = np.zeros((tokens.shape[0], self.vocab), np.float32)
+            for i in range(tokens.shape[0]):
+                logits[i, (int(tokens[i, 0]) + 1) % self.vocab] = 1.0
+            return logits, k, v
+
+    @contextlib.contextmanager
+    def recorder_arm(trace_dir):
+        prior = os.environ.pop(ENV_TRACE_DIR, None)
+        if trace_dir is not None:
+            os.environ[ENV_TRACE_DIR] = trace_dir
+        reset_recorder()
+        try:
+            yield
+        finally:
+            get_recorder().flush()
+            if prior is None:
+                os.environ.pop(ENV_TRACE_DIR, None)
+            else:
+                os.environ[ENV_TRACE_DIR] = prior
+            reset_recorder()
+
+    def spawn_gateway(kv_port, gid):
+        """One real gateway process, TLS-only, parsed for its port."""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_sandbox.gateway",
+             "--kv-port", str(kv_port), "--gateway-id", gid,
+             "--token", TOKEN, "--admission", "none",
+             "--policy", "prefix",
+             "--tls-cert", cert, "--tls-key", key],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        line = proc.stdout.readline()
+        if "listening on" not in line or "tls=on" not in line:
+            proc.kill()
+            raise RuntimeError(f"gateway {gid} failed to start: {line!r}")
+        port = int(line.split("listening on ")[1]
+                   .split()[0].rsplit(":", 1)[1])
+        return proc, port
+
+    def plaintext_probe(port):
+        """A cleartext frame against the TLS listener: the server must
+        close that connection cleanly (EOF, no bytes served back)."""
+        s = _socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        try:
+            s.sendall(struct.pack("!BI", gwire.OP_HELLO, 2) + b"{}")
+            s.settimeout(5.0)
+            try:
+                return s.recv(64) == b""
+            except (ConnectionError, OSError):
+                return True  # reset is a close too, just less polite
+        finally:
+            s.close()
+
+    def run_campaign(campaign_seed, *, schedule_for, trace_dir=None,
+                     probe=False, plaintext=False):
+        """One fully isolated fleet + one seeded campaign against it."""
+        server = KVServer()
+        kv = KVClient(port=server.port)
+        stop = threading.Event()
+        workers, threads, clones = [], [], []
+        for i in range(n_replicas):
+            wkv = kv.clone()
+            clones.append(wkv)
+            eng = ContinuousEngine(
+                None,
+                ServeConfig(model=mcfg, cache=ccfg, max_batch=4,
+                            buckets=_ModeledStep.buckets, max_waiting=0),
+                step=_ModeledStep())
+            w = ReplicaWorker(wkv, eng, tag=f"r{i}", lease_ttl=1.0,
+                              load_interval=0.05)
+            workers.append(w)
+
+            def loop(worker=w):
+                while not stop.is_set():
+                    worker.tick()
+                    if worker.engine.idle:
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name=f"chaos-replica-{i}")
+            threads.append(t)
+            t.start()
+        procs = {}
+        endpoints = []
+        for i in range(n_gateways):
+            gid = f"gw{i}"
+            proc, port = spawn_gateway(server.port, gid)
+            procs[gid] = proc
+            endpoints.append(("127.0.0.1", port))
+        trace = workload.synthesize(campaign_seed, n_requests,
+                                    duration_s=duration_s,
+                                    prompt_tokens=(8, 24),
+                                    decode_tokens=(2, 6))
+        schedule = schedule_for(campaign_seed)
+        client = GatewayClient(endpoints=list(endpoints), token=TOKEN,
+                               tls=make_client_ssl_context(ca),
+                               backoff_base=0.02)
+        submit_s = []
+
+        def door(rid, prompt, max_new):
+            t0 = time.monotonic()
+            ok = client.submit(rid, prompt, max_new)
+            submit_s.append(time.monotonic() - t0)
+            return ok
+
+        def sigkill(gid):
+            procs[gid].send_signal(_signal.SIGKILL)
+
+        out = {}
+        cm = recorder_arm(trace_dir) if trace_dir is not None \
+            else contextlib.nullcontext()
+        try:
+            time.sleep(0.3)  # first load reports + hb leases land
+            out["live_gateways"] = len(live_gateway_endpoints(kv))
+            with cm:
+                campaign = ChaosCampaign(
+                    kv, trace, door, seed=campaign_seed,
+                    schedule=schedule,
+                    hooks={"kill_gateway": sigkill},
+                    block_size=BLOCK, verdict_timeout=180.0)
+                res = campaign.run()
+            sub = np.array(submit_s or [0.0])
+            out.update(
+                seed=campaign_seed, submitted=res.submitted,
+                admitted=res.admitted, retried=res.retried,
+                lost=len(res.lost),
+                verdicts_ok=sum(1 for v in res.verdicts.values()
+                                if v["verdict"] == "ok"),
+                fired=[f["action"] for f in res.fired],
+                failovers=client.stats.failovers,
+                submit_p50_ms=round(float(np.percentile(sub, 50)) * 1e3, 2),
+                submit_p99_ms=round(float(np.percentile(sub, 99)) * 1e3, 2),
+                exactly_once_ok=res.ok,
+                alert_claims_ok=check_alert_claims(kv) == [],
+                audit=res.audit_bytes(),
+            )
+            if plaintext:
+                # the survivor the client is parked on keeps serving;
+                # a plaintext probe against it is refused cleanly
+                host, port = client.endpoint
+                out["plaintext_refused"] = plaintext_probe(port)
+                before = client.gateway_stats()["stats"]
+                out["tls_handshake_failures"] = int(
+                    before.get("tls_handshake_failures", 0))
+                out["serves_after_plaintext"] = bool(
+                    client.gateway_stats()["admission"] == "none")
+            if probe:
+                row = dict(workload.replay_order(trace)[0])
+                row["prompt_tokens"] = max(int(row["prompt_tokens"]),
+                                           BLOCK)
+                prompt = campaign.prompt_for(row)
+                head = chain_digest(prompt[:BLOCK], BLOCK)[0]
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    if any(head in r.get("prefix_digest", ())
+                           for r in read_load_reports(kv).values()):
+                        break
+                    time.sleep(0.02)
+                rid = f"probe-{campaign_seed}"
+                out["prefix_probe_routed"] = bool(
+                    prefix_probe(client, prompt, rid))
+                client.result(rid, timeout=60.0)
+        finally:
+            client.close()
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.send_signal(_signal.SIGTERM)
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                proc.stdout.close()
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            for w in workers:
+                w.engine.drain_to_requests()
+            for c in clones:
+                c.close()
+            kv.close()
+            server.stop()
+        return out
+
+    kill_seed = seed + 1
+    mid_kill = [ChaosFault(at_s=round(duration_s * 0.4, 6),
+                           action="kill_gateway", target="gw0")]
+
+    def matrix_schedule(s):
+        return mid_kill + build_schedule(s, duration_s=duration_s, targets={
+            "shed_storm": [f"r{i}" for i in range(n_replicas)],
+            "stall_replica": [f"r{i}:0.2" for i in range(n_replicas)],
+        }, n_faults=3)
+
+    dirs = {arm: tempfile.mkdtemp(prefix=f"chaos-{arm}-")
+            for arm in ("ctrl", "kill")}
+    # fault-free control over the same trace, recorded for the gate
+    ctrl = run_campaign(kill_seed, schedule_for=lambda s: [],
+                        trace_dir=dirs["ctrl"])
+    # the headline arm: SIGKILL the connected gateway mid-load, recorded
+    killarm = run_campaign(kill_seed, schedule_for=lambda s: mid_kill,
+                           trace_dir=dirs["kill"], probe=True,
+                           plaintext=True)
+    # determinism receipt: same seed, fresh fleet, byte-identical audit
+    killarm_replay = run_campaign(kill_seed, schedule_for=lambda s: mid_kill)
+    # the seeded matrix: full fault families, distinct seeds
+    matrix = [run_campaign(s, schedule_for=matrix_schedule)
+              for s in matrix_seeds]
+
+    for arm, d in dirs.items():
+        analysis = critpath.analyze(collect.load_merged(d))
+        critpath.save_profile(analysis["profile"],
+                              os.path.join(d, "critpath_profile.json"))
+    td = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "tracediff.py"),
+         os.path.join(dirs["ctrl"], "critpath_profile.json"),
+         os.path.join(dirs["kill"], "critpath_profile.json"),
+         "--min-share", "0.05"],
+        capture_output=True, text=True)
+
+    def green(c):
+        return bool(c["exactly_once_ok"] and c["lost"] == 0
+                    and c["verdicts_ok"] == c["submitted"]
+                    and c["alert_claims_ok"])
+
+    audit_identical = killarm["audit"] == killarm_replay["audit"]
+    for c in (ctrl, killarm, killarm_replay, *matrix):
+        c.pop("audit", None)
+    return {
+        "metric": "chaos",
+        "unit": "requests lost; campaigns green",
+        "gateways": n_gateways,
+        "replicas": n_replicas,
+        "requests_per_campaign": n_requests,
+        "control": ctrl,
+        "sigkill_campaign": killarm,
+        "seeded_campaigns": matrix,
+        "campaigns_green": sum(green(c) for c in (killarm, *matrix)),
+        "all_campaigns_green": bool(all(green(c)
+                                        for c in (killarm, *matrix))),
+        "sigkill_zero_loss": bool(killarm["lost"] == 0
+                                  and killarm["failovers"] >= 1),
+        "audit_replay_identical": bool(audit_identical),
+        "tls_plaintext_refused": bool(killarm.get("plaintext_refused")),
+        "tls_handshake_failures_counted": bool(
+            killarm.get("tls_handshake_failures", 0) >= 1),
+        "prefix_probe_routed": bool(killarm.get("prefix_probe_routed")),
+        "tracediff_gate_exit": td.returncode,
+        "tracediff_gate_ok": bool(td.returncode == 0),
+        "source": "real gateway processes (TLS wire, shared-secret hello) "
+                  "SIGKILLed mid-load by os signal; replica threads over "
+                  "the real engine with sleep-modeled step; claim audit "
+                  "read straight from the KV store; tracediff run as the "
+                  "committed CLI on saved critpath profiles",
+    }
+
+
 def bench_obs(*, quick: bool = False, seed: int = 0) -> dict:
     """Flight-recorder overhead receipts: is tracing cheap enough to
     leave ON?
@@ -3738,6 +4101,7 @@ def main():
     p.add_argument("--metric",
                    choices=["grad_compress", "overlap", "donation",
                             "cluster", "serve", "serve_slo", "gateway",
+                            "chaos",
                             "obs", "health", "deploy", "mpmd", "critpath",
                             "images_per_sec",
                             "allreduce_bw", "pallas",
@@ -3799,6 +4163,11 @@ def main():
     if args.metric == "gateway":
         # chipless routing/admission receipt over real sockets; no probe
         _emit(bench_gateway(quick=args.quick), args)
+        return
+    if args.metric == "chaos":
+        # chipless HA/chaos receipt: real gateway processes over TLS,
+        # seeded fault campaigns, claim audit from the store; no probe
+        _emit(bench_chaos(quick=args.quick), args)
         return
     if args.metric == "obs":
         # chipless flight-recorder overhead receipt; no probe

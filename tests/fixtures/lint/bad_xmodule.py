@@ -1,0 +1,37 @@
+"""Seeded cross-module violations — every pattern here must be FLAGGED
+when linted TOGETHER with ``xmodule_helper.py`` (run_collective_pass /
+run_control_pass over both files). Linted alone, the import targets are
+unknowable and the file reads clean — that asymmetry is the regression
+this fixture pins.
+"""
+
+import jax
+
+import xmodule_helper
+from xmodule_helper import sync_all, sync_step
+
+
+def rank_branch_from_import(tree, rank, axis):  # GL-C103
+    if rank == 0:
+        tree = sync_all(tree, axis)  # pmean lives one import away
+    return tree
+
+
+def rank_branch_module_attr(tree, process_index, axis):  # GL-C103
+    if process_index == 0:
+        tree = xmodule_helper.sync_all(tree, axis)
+    return tree
+
+
+def rank_exit_then_imported_chain(tree, rank, axis):  # GL-C102
+    if rank != 0:
+        return tree  # other ranks bail...
+    return sync_step(tree, axis)  # ...before a depth-2 imported collective
+
+
+def drain_with_imported_sync(batches, axis):  # GL-R305
+    stepper = jax.jit(sync_step)  # multi-device: body pmean is imported
+    out = []
+    for b in batches:
+        out.append(stepper(b, axis))  # dispatch storm per iteration
+    return out

@@ -1,0 +1,25 @@
+"""The guarded twin of ``bad_xmodule.py`` — same imports, same helpers,
+zero findings. Every rank collapses onto the same imported collective
+sequence, and the jitted dispatch happens once, not per iteration.
+"""
+
+import jax
+
+import xmodule_helper
+from xmodule_helper import plain_scale, sync_all, sync_step
+
+
+def all_ranks_sync(tree, rank, axis):
+    tree = sync_all(tree, axis)  # unconditional: every rank participates
+    if rank == 0:
+        tree = plain_scale(tree, 1.0)  # rank-guarded but collective-free
+    return tree
+
+
+def all_ranks_module_attr(tree, axis):
+    return xmodule_helper.sync_all(tree, axis)
+
+
+def batched_imported_sync(batch, axis):
+    stepper = jax.jit(sync_step)
+    return stepper(batch, axis)  # one dispatch; the loop lives in-program

@@ -1,0 +1,24 @@
+"""Collective-bearing helpers the cross-module fixtures import.
+
+Clean on its own: every collective here runs unconditionally. The
+violations live in ``bad_xmodule.py``, which hides these calls behind an
+``import`` — the hole xmodule.CrossIndex closes. Never imported by the
+tests; only ever parsed.
+"""
+
+from jax import lax
+
+
+def sync_all(tree, axis):
+    return lax.pmean(tree, axis)
+
+
+def sync_step(tree, axis):
+    # depth-2 chain: bearing must propagate THROUGH this module before
+    # crossing the import edge
+    return sync_all(tree, axis)
+
+
+def plain_scale(tree, factor):
+    # no collective anywhere below this: calls to it must never flag
+    return {k: v * factor for k, v in tree.items()}

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Regenerate the committed TLS test fixtures. Long validity on purpose:
+# these are test-only keys for 127.0.0.1, never trusted outside the suite.
+set -e
+cd "$(dirname "$0")"
+days=36500
+subj_ca="/CN=tpu-sandbox test CA"
+subj_alt="/CN=tpu-sandbox WRONG CA"
+ext="subjectAltName=DNS:localhost,IP:127.0.0.1"
+
+openssl req -x509 -newkey rsa:2048 -nodes -keyout ca.key -out ca.pem \
+    -days "$days" -subj "$subj_ca"
+openssl req -newkey rsa:2048 -nodes -keyout server.key -out server.csr \
+    -subj "/CN=localhost"
+openssl x509 -req -in server.csr -CA ca.pem -CAkey ca.key \
+    -CAcreateserial -out server.pem -days "$days" -extfile <(echo "$ext")
+
+# a second, unrelated CA: the wrong-trust-root client test
+openssl req -x509 -newkey rsa:2048 -nodes -keyout wrong_ca.key \
+    -out wrong_ca.pem -days "$days" -subj "$subj_alt"
+rm -f server.csr ca.srl

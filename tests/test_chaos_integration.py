@@ -1,0 +1,223 @@
+"""Full chaos fault matrix, slow tier (module auto-marked slow).
+
+Three seeded campaigns drawn by :func:`build_schedule` over every fault
+family the in-process harness can execute (gateway kill, replica shed
+storm, replica stall), against a 3-gateway / 3-replica stub fleet. Each
+must end with zero lost requests and a clean claim audit, and after the
+wreckage a prefix probe checks failover didn't degrade the door to
+blind load balancing. The real-process twin with TLS on the wire is
+``bench.py --metric chaos``.
+"""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sandbox.gateway.client import GatewayClient
+from tpu_sandbox.gateway.fleet import FleetSpec
+from tpu_sandbox.gateway.server import Gateway
+from tpu_sandbox.models.transformer import TransformerConfig
+from tpu_sandbox.obs import workload
+from tpu_sandbox.runtime.chaos import (ChaosCampaign, build_schedule,
+                                       check_alert_claims, prefix_probe)
+from tpu_sandbox.serve.cache import CacheConfig, chain_digest
+from tpu_sandbox.serve.engine import ContinuousEngine, ServeConfig
+
+MCFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                         d_ff=64, max_len=128)
+CCFG = CacheConfig(num_blocks=32, block_size=4, max_blocks_per_seq=8)
+BLOCK = CCFG.block_size
+
+
+class _StubStep:
+    def __init__(self, buckets=(8, 16), vocab=64):
+        self.buckets = tuple(buckets)
+        self.vocab = vocab
+        self.prefill = {b: self._prefill for b in self.buckets}
+
+    def pick_bucket(self, plen):
+        for b in self.buckets:
+            if plen <= b:
+                return b
+        raise ValueError(f"prompt of {plen} exceeds buckets {self.buckets}")
+
+    def _prefill(self, params, k, v, toks, dest, last):
+        toks = np.asarray(toks)
+        logits = np.zeros((self.vocab,), np.float32)
+        logits[(int(toks[0, int(last)]) + 1) % self.vocab] = 1.0
+        return logits, k, v
+
+    def decode(self, params, k, v, tokens, lengths, tables):
+        tokens = np.asarray(tokens)
+        logits = np.zeros((tokens.shape[0], self.vocab), np.float32)
+        for i in range(tokens.shape[0]):
+            logits[i, (int(tokens[i, 0]) + 1) % self.vocab] = 1.0
+        return logits, k, v
+
+
+def _worker(kv, tag):
+    from tpu_sandbox.serve.replica import ReplicaWorker
+
+    cfg = ServeConfig(model=MCFG, cache=CCFG, max_batch=2, buckets=(8, 16))
+    eng = ContinuousEngine(None, cfg, step=_StubStep(), clock=time.monotonic)
+    return ReplicaWorker(kv, eng, tag=tag, lease_ttl=1.0, load_interval=0.02)
+
+
+@contextlib.contextmanager
+def _pumping(*workers):
+    stop = threading.Event()
+
+    def run():
+        while not stop.is_set():
+            for w in workers:
+                w.tick()
+            time.sleep(0.001)
+
+    t = threading.Thread(target=run, name="chaos-pump", daemon=True)
+    t.start()
+    try:
+        yield stop
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+
+
+def _run_matrix_campaign(seed):
+    """One seeded campaign over the full in-process fault matrix."""
+    from tpu_sandbox.runtime.kvstore import KVClient, KVServer
+
+    server = KVServer()
+    kv = KVClient(port=server.port)
+    clones = []
+
+    def clone():
+        c = kv.clone()
+        clones.append(c)
+        return c
+
+    trace = workload.synthesize(seed, 16, duration_s=0.8,
+                                prompt_tokens=(4, 10),
+                                decode_tokens=(2, 4))
+    # gw2 is never a kill candidate, so the client always has a door
+    schedule = build_schedule(seed, duration_s=0.8, targets={
+        "kill_gateway": ["gw0", "gw1"],
+        "shed_storm": ["w0", "w1", "w2"],
+        "stall_replica": ["w0:0.3", "w1:0.3", "w2:0.3"],
+    }, n_faults=5)
+    fleets = [FleetSpec(block_size=BLOCK)]
+    gws = {
+        gid: Gateway(kv, fleets, gateway_id=gid, hb_ttl=0.5,
+                     refresh_min_s=0.005).start()
+        for gid in ("gw0", "gw1", "gw2")
+    }
+
+    def kill_gateway(gid):
+        if not gws[gid].killed:  # a seed may draw the same target twice
+            gws[gid].kill()
+
+    workers = [_worker(clone(), f"w{i}") for i in range(3)]
+    client = None
+    try:
+        with _pumping(*workers):
+            client = GatewayClient(
+                endpoints=[("127.0.0.1", gws[g].port)
+                           for g in ("gw0", "gw1", "gw2")],
+                backoff_base=0.01)
+            campaign = ChaosCampaign(
+                clone(), trace, client.submit, seed=seed,
+                schedule=schedule,
+                hooks={"kill_gateway": kill_gateway},
+                block_size=BLOCK, verdict_timeout=120.0)
+            res = campaign.run()
+            alert_failures = check_alert_claims(kv)
+            routed = _probe_after(kv, client, campaign, trace, seed)
+    finally:
+        if client is not None:
+            client.close()
+        for g in gws.values():
+            g.close()
+        for c in clones:
+            c.close()
+        kv.close()
+        server.stop()
+    return res, alert_failures, routed
+
+
+def _probe_after(kv, client, campaign, trace, seed, timeout=30.0):
+    """Wait until some survivor advertises the chain's first block, then
+    ask a surviving gateway to route one more request on that chain."""
+    from tpu_sandbox.serve.replica import read_load_reports
+
+    row = dict(workload.replay_order(trace)[0])
+    row["prompt_tokens"] = max(int(row["prompt_tokens"]), BLOCK)
+    prompt = campaign.prompt_for(row)
+    head = chain_digest(prompt[:BLOCK], BLOCK)[0]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reports = read_load_reports(kv)
+        if any(head in r.get("prefix_digest", ())
+               for r in reports.values()):
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(f"no replica ever advertised block {head}")
+    rid = f"probe-{seed}"
+    routed = prefix_probe(client, prompt, rid)
+    assert client.result(rid, timeout=60.0)["verdict"] == "ok"
+    return routed
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_matrix_campaign_zero_loss(seed):
+    res, alert_failures, routed = _run_matrix_campaign(seed)
+    assert res.ok, res.failures
+    assert res.lost == []
+    assert res.submitted == 16 and len(res.verdicts) == 16
+    assert all(v["verdict"] == "ok" and v["tokens"]
+               for v in res.verdicts.values())
+    assert len(res.fired) == 5
+    assert alert_failures == []
+    assert routed, "prefix routing never engaged after the campaign"
+
+
+def test_distinct_seeds_draw_distinct_campaigns():
+    targets = {"kill_gateway": ["gw0", "gw1"],
+               "shed_storm": ["w0", "w1", "w2"],
+               "stall_replica": ["w0:0.3", "w1:0.3", "w2:0.3"]}
+    drawn = [tuple(build_schedule(s, duration_s=0.8, targets=targets,
+                                  n_faults=5))
+             for s in (101, 202, 303)]
+    assert len(set(drawn)) == 3
+
+
+def test_bench_chaos_cli_prints_one_json_line():
+    """`bench.py --metric chaos --quick` end to end in a fresh
+    interpreter: real gateway processes over TLS, a real SIGKILL, the
+    claim audit and the tracediff gate. Quick mode is too small for the
+    latency numbers to mean anything, so only the invariants are
+    asserted; BENCH_r13.json holds a committed full run."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"),
+         "--metric", "chaos", "--quick"],
+        capture_output=True, text=True, timeout=540, cwd=str(repo),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "chaos"
+    assert out["all_campaigns_green"] is True
+    assert out["sigkill_zero_loss"] is True
+    assert out["audit_replay_identical"] is True
+    assert out["tls_plaintext_refused"] is True
+    assert out["tracediff_gate_ok"] is True
+    assert out["sigkill_campaign"]["failovers"] >= 1

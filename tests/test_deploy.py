@@ -457,3 +457,31 @@ def test_door_no_replicas_fast_fail_over_wire(kv_pair):
     assert kv.get(k_done("r0")) is not None
     assert json.loads(kv.get(k_result("r0")))["reason"] == "door:no_replicas"
     assert gw.stats.shed_door == 1 and gw.stats.admitted == 0
+
+
+# -- canary choice: least-loaded, pinned across ticks and failover ------------
+
+
+def test_pick_canary_least_loaded_persisted_across_failover(kv_pair):
+    _, kv, _ = kv_pair
+    ctrl = _controller(kv)
+    reports = {"a": {"queue_depth": 3, "active": 1},
+               "b": {"queue_depth": 0, "active": 1},
+               "c": {"queue_depth": 1, "active": 0}}
+    tags = ["a", "b", "c"]
+    # least queued+active work wins; the b/c tie (load 1) breaks on tag
+    assert ctrl._pick_canary(7, reports, tags) == "b"
+    # persisted: the choice must not flap as load shifts between ticks
+    reports["b"]["queue_depth"] = 9
+    assert ctrl._pick_canary(7, reports, tags) == "b"
+    # a successor controller (leader failover mid-canary) swaps and
+    # measures the SAME replica it inherited
+    ctrl2 = _controller(kv)
+    assert ctrl2._pick_canary(7, reports, tags) == "b"
+    # the persisted canary died (report gone): re-chosen least-loaded
+    del reports["b"]
+    assert ctrl._pick_canary(7, reports, ["a", "c"]) == "c"
+    assert ctrl2._pick_canary(7, reports, ["a", "c"]) == "c"
+    # a different rollout seq is a fresh choice, not the inherited one
+    assert ctrl._pick_canary(8, {"a": {"queue_depth": 0, "active": 0},
+                                 "c": reports["c"]}, ["a", "c"]) == "a"

@@ -22,6 +22,7 @@ test_gateway_integration.py.
 
 import contextlib
 import json
+import os
 import socket
 import struct
 import threading
@@ -31,7 +32,9 @@ import pytest
 
 from tpu_sandbox.gateway import routing, wire
 from tpu_sandbox.gateway.fleet import (FleetSpec, fleet_kv, fleet_namespace)
-from tpu_sandbox.gateway.server import Gateway, live_gateways
+from tpu_sandbox.gateway.server import (Gateway, k_gateway_hb,
+                                        live_gateway_endpoints,
+                                        live_gateways)
 from tpu_sandbox.gateway.client import (GatewayAuthError, GatewayClient,
                                         GatewayError, RetriesExhausted)
 from tpu_sandbox.models.transformer import TransformerConfig
@@ -729,3 +732,196 @@ def test_bench_gateway_quick_smoke():
         assert run["completed_ok"] + run["engine_shed"] == run["admitted"]
     assert "prefix_beats_random_p99" in out
     assert "feasible_goodput_holds" in out
+
+
+# -- HA front door: heartbeat leases, failover, TLS ---------------------------
+
+TLSDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "tls")
+
+
+def _server_ctx():
+    return wire.make_server_ssl_context(
+        os.path.join(TLSDIR, "server.pem"), os.path.join(TLSDIR, "server.key"))
+
+
+def _client_ctx(ca="ca.pem"):
+    return wire.make_client_ssl_context(os.path.join(TLSDIR, ca))
+
+
+def _wait_endpoints(kv, want_ids, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ids = [e[0] for e in live_gateway_endpoints(kv)]
+        if ids == want_ids:
+            return ids
+        time.sleep(0.02)
+    raise AssertionError(
+        f"endpoints {[e[0] for e in live_gateway_endpoints(kv)]}, "
+        f"wanted {want_ids}")
+
+
+def test_hb_lease_discovery_close_vs_kill(kv_pair):
+    """Every gateway registers a TTL'd gateway/hb/<id> lease. A clean
+    close retires it immediately; a kill() (the SIGKILL stand-in) leaves
+    it to lapse — discovery degrades on its own, nothing cleans up."""
+    _, kv, _ = kv_pair
+    g0 = _gateway(kv, gateway_id="gw0", hb_ttl=0.4)
+    g1 = _gateway(kv, gateway_id="gw1", hb_ttl=0.4)
+    try:
+        eps = _wait_endpoints(kv, ["gw0", "gw1"])
+        by_id = {e[0]: e for e in live_gateway_endpoints(kv)}
+        assert by_id["gw0"][2] == g0.port and by_id["gw1"][2] == g1.port
+        g1.close()  # clean: lease deleted right now
+        assert [e[0] for e in live_gateway_endpoints(kv)] == ["gw0"]
+        g0.kill()   # abrupt: lease still there until the TTL lapses
+        assert kv.try_get(k_gateway_hb("gw0")) is not None
+        _wait_endpoints(kv, [], timeout=3.0)
+    finally:
+        g0.close()
+        g1.close()
+
+
+def test_client_fails_over_when_gateway_killed(kv_pair):
+    """Kill the connected gateway mid-session: the next op fails over to
+    the survivor and completes against the same store state."""
+    _, kv, clone = kv_pair
+    w = _worker(clone(), tag="w0")
+    g0 = _gateway(kv, gateway_id="gw0", hb_ttl=0.4)
+    g1 = _gateway(kv, gateway_id="gw1", hb_ttl=0.4)
+    client = None
+    try:
+        with _pumping(w):
+            _wait_for_report(kv, "w0")
+            client = GatewayClient(
+                endpoints=[("127.0.0.1", g0.port), ("127.0.0.1", g1.port)],
+                backoff_base=0.01)
+            assert client.submit("r0", [1, 2, 3], 3) is True
+            assert client.result("r0", timeout=30.0)["verdict"] == "ok"
+            assert client.stats.failovers == 0
+            g0.kill()
+            assert client.submit("r1", [1, 2, 3], 3) is True
+            got = client.result("r1", timeout=30.0)
+            assert got["verdict"] == "ok" and got["tokens"] == [4, 5, 6]
+            assert client.stats.failovers >= 1
+            assert client.endpoint == ("127.0.0.1", g1.port)
+    finally:
+        if client is not None:
+            client.close()
+        g0.close()
+        g1.close()
+
+
+def test_failover_submit_repolls_verdict_never_reexecutes(kv_pair):
+    """The one op that is not blindly reissued: a submit that dies with
+    the gateway first polls the verdict slot on the survivor — a request
+    whose verdict already landed is returned, never re-enqueued."""
+    from tpu_sandbox.serve.replica import k_done, k_req, k_result
+
+    _, kv, _ = kv_pair
+    g0 = _gateway(kv, gateway_id="gw0", hb_ttl=0.4)
+    g1 = _gateway(kv, gateway_id="gw1", hb_ttl=0.4)
+    client = None
+    try:
+        client = GatewayClient(
+            endpoints=[("127.0.0.1", g0.port), ("127.0.0.1", g1.port)],
+            backoff_base=0.01)
+        # the request's verdict landed (elsewhere) before this submit
+        kv.set(k_result("done-rid"), json.dumps(
+            {"rid": "done-rid", "verdict": "ok", "tokens": [9, 9],
+             "replica": "w9"}))
+        assert kv.add(k_done("done-rid")) == 1
+        g0.kill()  # the client's socket dies with it
+        assert client.submit("done-rid", [1, 2, 3], 2) is True
+        assert client.stats.failovers >= 1
+        got = client.result("done-rid", timeout=10.0)
+        assert got["tokens"] == [9, 9] and got["replica"] == "w9"
+        # never re-executed: no request body, no queue entry was written
+        assert kv.try_get(k_req("done-rid")) is None
+    finally:
+        if client is not None:
+            client.close()
+        g0.close()
+        g1.close()
+
+
+def test_tls_round_trip_and_plaintext_refused(kv_pair):
+    """With a TLS listener every byte on the external wire is encrypted:
+    a TLS client (hello inside the channel) round-trips; a plaintext
+    frame dies in the handshake — counted, connection closed, accept
+    loop untouched."""
+    _, kv, clone = kv_pair
+    w = _worker(clone(), tag="w0")
+    with _gateway(kv, tls=_server_ctx(), token="s3cr3t") as g, _pumping(w):
+        _wait_for_report(kv, "w0")
+        with GatewayClient(g.port, token="s3cr3t",
+                           tls=_client_ctx()) as client:
+            assert client.submit("r0", [1, 2, 3], 3) is True
+            assert client.result("r0", timeout=30.0)["tokens"] == [4, 5, 6]
+        # plaintext probe: the gateway refuses during the handshake
+        s = _raw(g)
+        with contextlib.suppress(ConnectionError, OSError):
+            wire.send_frame(s, wire.OP_STATS, {})
+            s.settimeout(5.0)
+            assert s.recv(1) == b""  # closed, never answered
+        s.close()
+        _wait_stat(g, "tls_handshake_failures", 1)
+        # the accept loop survived: TLS clients still served
+        with GatewayClient(g.port, token="s3cr3t",
+                           tls=_client_ctx()) as client:
+            assert client.gateway_stats()["stats"]["connections"] >= 1
+
+
+def test_tls_wrong_ca_never_connects(kv_pair):
+    _, kv, _ = kv_pair
+    with _gateway(kv, tls=_server_ctx(), token="s3cr3t") as g:
+        with pytest.raises(GatewayError):
+            GatewayClient(g.port, token="s3cr3t",
+                          tls=_client_ctx("wrong_ca.pem"),
+                          failover_cycles=1, backoff_base=0.0)
+        _wait_stat(g, "tls_handshake_failures", 1)
+        with GatewayClient(g.port, token="s3cr3t",
+                           tls=_client_ctx()) as client:
+            assert client.gateway_stats()["stats"]["requests"] == 0
+
+
+def test_tls_mid_handshake_disconnect_and_oversized_frame(kv_pair):
+    """Two adversaries inside the TLS path: a peer that connects and
+    hangs up mid-handshake, and an authenticated TLS peer that sends an
+    oversized length prefix inside the encrypted channel. Both end their
+    own connection; neither wedges the accept loop."""
+    _, kv, _ = kv_pair
+    with _gateway(kv, tls=_server_ctx()) as g:
+        # mid-handshake disconnect: TCP connect, then silence and close
+        s = _raw(g)
+        s.close()
+        # oversized frame INSIDE an established TLS channel
+        tls = _client_ctx().wrap_socket(_raw(g), server_hostname="127.0.0.1")
+        tls.sendall(struct.pack("!BI", wire.OP_SUBMIT, 1 << 31))
+        assert _closed_by_peer(tls)
+        tls.close()
+        _wait_stat(g, "protocol_errors", 1)
+        with GatewayClient(g.port, tls=_client_ctx()) as client:
+            assert client.gateway_stats()["stats"]["protocol_errors"] == 1
+
+
+def test_requests_stamped_with_gateway_id(kv_pair):
+    """Every routed request carries the gateway's id; replicas count
+    claims per gateway in their load report — the chaos claim audit's
+    attribution evidence."""
+    from tpu_sandbox.serve.replica import read_load_reports
+
+    _, kv, clone = kv_pair
+    w = _worker(clone(), tag="w0")
+    with _gateway(kv, gateway_id="gw-stamp") as g, _pumping(w):
+        _wait_for_report(kv, "w0")
+        with GatewayClient(g.port) as client:
+            assert client.submit("r0", [1, 2, 3], 3) is True
+            assert client.result("r0", timeout=30.0)["verdict"] == "ok"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            rep = read_load_reports(kv).get("w0", {})
+            if rep.get("gw_claims"):
+                break
+            time.sleep(0.02)
+        assert rep["gw_claims"] == {"gw-stamp": 1}

@@ -89,6 +89,53 @@ def test_clean_collective_fixture_passes():
 
 
 # ---------------------------------------------------------------------------
+# Cross-module resolution (xmodule.CrossIndex)
+# ---------------------------------------------------------------------------
+
+
+def _xmodule_paths(*names):
+    return [os.path.join(FIXTURES, n) for n in names]
+
+
+def test_cross_module_fixture_fires_through_imports():
+    """Collective-bearing calls hidden one (or two) imports away resolve
+    when the file set is linted together: from-import, module-attribute,
+    post-rank-exit depth-2 chain, and a jit of an imported sync fn."""
+    paths = _xmodule_paths("xmodule_helper.py", "bad_xmodule.py")
+    findings = run_collective_pass(FIXTURES, paths=paths) \
+        + run_control_pass(FIXTURES, paths=paths)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {"GL-C102", "GL-C103", "GL-R305"}, \
+        [f.format() for f in findings]
+    # both import spellings of the rank-gated sync fire
+    assert len(by_rule["GL-C103"]) == 2
+    assert all("sync_all" in f.message for f in by_rule["GL-C103"])
+    # bearing crossed the import edge AND a local hop inside the helper
+    assert "sync_step" in by_rule["GL-C102"][0].message
+    assert "stepper" in by_rule["GL-R305"][0].snippet
+    # the helper module itself carries no findings
+    assert all(f.file.endswith("bad_xmodule.py") for f in findings)
+
+
+def test_cross_module_clean_twin_passes():
+    paths = _xmodule_paths("xmodule_helper.py", "clean_xmodule.py")
+    findings = run_collective_pass(FIXTURES, paths=paths) \
+        + run_control_pass(FIXTURES, paths=paths)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_cross_module_bad_file_reads_clean_alone():
+    """Single-file lint cannot see through imports — the asymmetry that
+    makes the whole-set run the only honest gate. If this starts firing,
+    the fixture's imports got inlined and the cross-module test above
+    stopped proving anything."""
+    findings = lint_coll(_fixture("bad_xmodule.py"), "bad_xmodule.py")
+    assert findings == [], [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
 # Pass 3 fixtures
 # ---------------------------------------------------------------------------
 

@@ -492,3 +492,66 @@ def test_sampled_request_replays_bitwise_after_requeue():
     eng2.submit(reqs[0])
     eng2.run_until_idle()
     assert eng2.results["s0"].tokens == want
+
+
+# -- client: canary-share pinning before enqueue ------------------------------
+
+
+def test_client_pins_canary_share_before_enqueue(kv_pair):
+    from tpu_sandbox.deploy.registry import k_shares
+    from tpu_sandbox.serve import replica as R
+    from tpu_sandbox.serve.client import ServeClient
+
+    _, kv = kv_pair
+    # no live shares (the common case): one try_get, no pin written
+    quiet = ServeClient(kv)
+    quiet.submit("r0", [1, 2, 3], 2)
+    assert kv.try_get(R.k_pin("r0")) is None
+    # a live canary split with all weight on version 7: every submit
+    # pins to 7 BEFORE the enqueue, so the first claimer sees it
+    kv.set(k_shares(""), json.dumps(
+        {"seq": 7, "shares": {"7": 1.0, "0": 0.0}}))
+    client = ServeClient(kv, share_seed=42)
+    client.submit("r1", [1, 2, 3], 2)
+    assert int(kv.get(R.k_pin("r1"))) == 7
+
+
+def test_client_share_draws_seeded_and_split(kv_pair):
+    from tpu_sandbox.deploy.registry import k_shares
+    from tpu_sandbox.serve import replica as R
+    from tpu_sandbox.serve.client import ServeClient
+
+    _, kv = kv_pair
+    kv.set(k_shares(""), json.dumps(
+        {"seq": 7, "shares": {"7": 0.5, "0": 0.5}}))
+
+    def draw_sequence(seed, tag):
+        c = ServeClient(kv, share_seed=seed)
+        pins = []
+        for i in range(8):
+            rid = f"{tag}-{i}"
+            c.submit(rid, [1, 2, 3], 2)
+            pins.append(int(kv.get(R.k_pin(rid))))
+        return pins
+
+    a = draw_sequence(1234, "a")
+    b = draw_sequence(1234, "b")
+    assert a == b  # same seed -> same version sequence (replayable)
+    assert set(a) == {0, 7}  # a 50/50 split actually splits in 8 draws
+
+
+def test_client_fleet_view_reads_root_shares(kv_pair):
+    from tpu_sandbox.deploy.registry import k_shares
+    from tpu_sandbox.gateway.fleet import fleet_kv
+    from tpu_sandbox.serve import replica as R
+    from tpu_sandbox.serve.client import ServeClient
+
+    _, kv = kv_pair
+    # deploy keys live at the store ROOT keyed by fleet; the serve pin
+    # lands inside the fleet namespace the client was built over
+    kv.set(k_shares("chat"), json.dumps(
+        {"seq": 3, "shares": {"3": 1.0}}))
+    client = ServeClient(fleet_kv(kv, "chat"), share_seed=0)
+    client.submit("r0", [1, 2, 3], 2)
+    assert int(kv.get("fleet/chat/" + R.k_pin("r0"))) == 3
+    assert kv.try_get(R.k_pin("r0")) is None  # nothing at the root

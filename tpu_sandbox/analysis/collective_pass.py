@@ -88,6 +88,17 @@ def _via_self(func: ast.AST) -> bool:
             and isinstance(func.value, ast.Name) and func.value.id == "self")
 
 
+def _recv_name(func: ast.AST) -> str | None:
+    """Single-name receiver of an attribute call: ``helpers.sync()`` ->
+    'helpers'. None for bare names, ``self.``, and dotted receivers —
+    only this shape can be an imported-module alias."""
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id != "self":
+        return func.value.id
+    return None
+
+
 def _base_name(expr: ast.AST) -> str | None:
     """Trailing name of a base-class expression (``Mixin``, ``mod.Mixin``)."""
     if isinstance(expr, ast.Name):
@@ -118,6 +129,10 @@ class _FunctionIndex:
         self.entries: list[tuple[str, str | None, ast.AST]] = []
         self._bare: dict[str, list[str]] = {}
         self._bases: dict[str, list[str]] = {}
+        #: cross-module hook, wired by xmodule.CrossIndex: callable
+        #: (recv, name) -> bool answering "does this call reach a
+        #: collective-bearing function in ANOTHER scanned module?"
+        self.external = None
         self._collect(tree, None)
         self.bearing = self._summarize()
 
@@ -164,10 +179,12 @@ class _FunctionIndex:
         return list(self._bare.get(name, []))
 
     def _direct_facts(self, fn: ast.AST) -> tuple[bool, set]:
-        """(has a literal collective, (via_self, name) of calls it makes) —
-        counting only this function's own body, not nested defs."""
+        """(has a literal collective, (via_self, recv, name) of calls it
+        makes) — counting only this function's own body, not nested
+        defs. ``recv`` is the single-name attribute receiver (the only
+        shape that can be an imported-module alias), else None."""
         has = False
-        calls: set[tuple[bool, str]] = set()
+        calls: set[tuple[bool, str | None, str]] = set()
         for node in ast.walk(fn):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node is not fn:
@@ -177,22 +194,25 @@ class _FunctionIndex:
                 if name in COLLECTIVE_NAMES:
                     has = True
                 elif name:
-                    calls.add((_via_self(node.func), name))
+                    calls.add((_via_self(node.func),
+                               _recv_name(node.func), name))
         return has, calls
 
     def _summarize(self) -> dict[str, bool]:
-        facts = {}
+        #: kept on the instance: xmodule.CrossIndex re-walks these same
+        #: edges for the global (cross-module) fixed point
+        self.facts: dict[str, tuple] = {}
         for key, cls, fn in self.entries:
             has, calls = self._direct_facts(fn)
-            facts[key] = (cls, has, calls)
-        bearing = {key: has for key, (_, has, _) in facts.items()}
+            self.facts[key] = (cls, has, calls)
+        bearing = {key: has for key, (_, has, _) in self.facts.items()}
         changed = True
         while changed:  # fixed point over the (acyclic-enough) call graph
             changed = False
-            for key, (cls, _, calls) in facts.items():
+            for key, (cls, _, calls) in self.facts.items():
                 if bearing[key]:
                     continue
-                for via_self, name in calls:
+                for via_self, _recv, name in calls:
                     if any(bearing.get(t, False)
                            for t in self.resolve(name, cls, via_self)):
                         bearing[key] = True
@@ -201,11 +221,19 @@ class _FunctionIndex:
         return bearing
 
     def bears_collective(self, name: str | None, *, cls: str | None = None,
-                         via_self: bool = False) -> bool:
+                         via_self: bool = False,
+                         recv: str | None = None) -> bool:
         if not name:
             return False
-        return any(self.bearing.get(k, False)
-                   for k in self.resolve(name, cls, via_self))
+        candidates = self.resolve(name, cls, via_self)
+        if candidates:
+            return any(self.bearing.get(k, False) for k in candidates)
+        # nothing local answers for this name: in a cross-module run the
+        # call may target an imported function (never for self.-calls —
+        # those stay inside the class hierarchy by construction)
+        if self.external is not None and not via_self:
+            return self.external(recv, name)
+        return False
 
 
 class _FunctionLinter(ast.NodeVisitor):
@@ -277,7 +305,8 @@ class _FunctionLinter(ast.NodeVisitor):
                     f"early exit at line {ln} (if {cond}: ...)",
                 )
         elif self.index.bears_collective(name, cls=self.cls,
-                                         via_self=_via_self(node.func)):
+                                         via_self=_via_self(node.func),
+                                         recv=_recv_name(node.func)):
             if self._rank_depth:
                 self._emit(
                     "GL-C103", node,
@@ -307,7 +336,8 @@ class _FunctionLinter(ast.NodeVisitor):
                     if name in COLLECTIVE_NAMES or \
                             self.index.bears_collective(
                                 name, cls=self.cls,
-                                via_self=_via_self(sub.func)):
+                                via_self=_via_self(sub.func),
+                                recv=_recv_name(sub.func)):
                         self._emit(
                             "GL-C101", site,
                             f"lax.cond on a rank-derived predicate runs "
@@ -319,8 +349,11 @@ class _FunctionLinter(ast.NodeVisitor):
             ref_self = (isinstance(branch, ast.Attribute)
                         and isinstance(branch.value, ast.Name)
                         and branch.value.id == "self")
+            ref_recv = _recv_name(branch) \
+                if isinstance(branch, ast.Attribute) else None
             if self.index.bears_collective(name, cls=self.cls,
-                                           via_self=ref_self):
+                                           via_self=ref_self,
+                                           recv=ref_recv):
                 self._emit(
                     "GL-C103", site,
                     f"lax.cond on a rank-derived predicate calls "
@@ -400,7 +433,8 @@ class _FunctionLinter(ast.NodeVisitor):
                             if name in COLLECTIVE_NAMES or \
                                     self.index.bears_collective(
                                         name, cls=self.cls,
-                                        via_self=_via_self(c.func)):
+                                        via_self=_via_self(c.func),
+                                        recv=_recv_name(c.func)):
                                 self._emit(
                                     "GL-C101", sub,
                                     f"collective-bearing '{name}' inside a "
@@ -408,18 +442,22 @@ class _FunctionLinter(ast.NodeVisitor):
                                 )
 
 
-def lint_source(source: str, path: str) -> list[Finding]:
-    """Lint one module's source text; ``path`` labels the findings."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [make_finding(
-            "GL-C101", path, e.lineno or 0,
-            f"unparseable module skipped ({e.msg})",
-            hint="fix the syntax error so the pass can see this file",
-        )]
+def lint_source(source: str, path: str, *,
+                index: _FunctionIndex | None = None) -> list[Finding]:
+    """Lint one module's source text; ``path`` labels the findings.
+    ``index`` lets a whole-tree run pass the module's cross-module-wired
+    _FunctionIndex (xmodule.CrossIndex) instead of a fresh local one."""
+    if index is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            return [make_finding(
+                "GL-C101", path, e.lineno or 0,
+                f"unparseable module skipped ({e.msg})",
+                hint="fix the syntax error so the pass can see this file",
+            )]
+        index = _FunctionIndex(tree)
     lines = source.splitlines()
-    index = _FunctionIndex(tree)
     findings: list[Finding] = []
     for _key, cls, fn in index.entries:
         linter = _FunctionLinter(path, lines, index, findings, cls)
@@ -444,17 +482,24 @@ def run_collective_pass(
 ) -> list[Finding]:
     """Lint every Python file under ``root`` (or just ``paths``); findings
     carry root-relative file labels. ``tests`` is excluded by default —
-    fixture corpora deliberately violate the rules."""
+    fixture corpora deliberately violate the rules. The whole file set is
+    indexed together (xmodule.CrossIndex) before any file is linted, so
+    collective-bearing calls hidden behind an import resolve."""
+    from tpu_sandbox.analysis import xmodule
+
     if paths is None:
         exclude = (exclude_dirs or set()) | {"tests", "related"}
         paths = list(iter_py_files(root, exclude))
-    findings: list[Finding] = []
+    sources: dict[str, str] = {}
     for p in paths:
-        rel = os.path.relpath(p, root)
         try:
             with open(p, "r", encoding="utf-8") as f:
-                src = f.read()
+                sources[p] = f.read()
         except OSError:
             continue
-        findings.extend(lint_source(src, rel))
+    cross = xmodule.CrossIndex(root, sources)
+    findings: list[Finding] = []
+    for p, src in sources.items():
+        rel = os.path.relpath(p, root)
+        findings.extend(lint_source(src, rel, index=cross.index_for(p)))
     return findings

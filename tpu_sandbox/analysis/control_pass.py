@@ -736,11 +736,17 @@ _COLLECTIVES = frozenset({
 })
 
 
-def _calls_collective(fn: ast.AST) -> bool:
+def _calls_collective(fn: ast.AST,
+                      external_coll: frozenset = frozenset()) -> bool:
+    """``external_coll``: names imported from other modules whose bodies
+    (transitively) issue collectives — xmodule.CrossIndex resolves them,
+    so a jitted wrapper around an imported sync helper still counts."""
     for node in ast.walk(fn):
         if isinstance(node, ast.Call):
             name = _final_attr(node.func)
-            if name in _COLLECTIVES or name == "shard_map":
+            if name in _COLLECTIVES or name == "shard_map" \
+                    or (isinstance(node.func, ast.Name)
+                        and name in external_coll):
                 return True
     return False
 
@@ -763,7 +769,7 @@ def _wrapped_is_multi_device(arg: ast.AST, coll_fns: set[str]) -> bool:
     if isinstance(arg, ast.Name):
         return arg.id in coll_fns
     if isinstance(arg, ast.Lambda):
-        return _calls_collective(arg)
+        return _calls_collective(arg, frozenset(coll_fns))
     if isinstance(arg, ast.Call):
         if _final_attr(arg.func) == "shard_map":
             return True
@@ -773,19 +779,22 @@ def _wrapped_is_multi_device(arg: ast.AST, coll_fns: set[str]) -> bool:
 
 
 def _multi_device_jits(
-    tree: ast.Module,
+    tree: ast.Module, external_coll: frozenset = frozenset(),
 ) -> tuple[set[str], set[str], set[ast.AST]]:
     """(names bound to multi-device jitted callables, names of functions
     that call collectives, jit-decorated defs).
 
     The last set matters for scoping: a loop *inside* a jitted function
     is traced into one program (one dispatch), so it is exempt.
+    ``external_coll`` (from-imported collective-bearing functions, per
+    xmodule.CrossIndex) count as collective-calling directly — a
+    ``jax.jit(imported_sync)`` is exactly as multi-device as a local one.
     """
     coll_fns = {
         node.name for node in ast.walk(tree)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-        and _calls_collective(node)
-    }
+        and _calls_collective(node, external_coll)
+    } | set(external_coll)
     jitted: set[str] = set()
     traced_defs: set[ast.AST] = set()
     for node in ast.walk(tree):
@@ -820,9 +829,9 @@ def _loops_outside_traced(tree: ast.Module, traced_defs: set[ast.AST]):
 
 def _check_launch_storms(
     tree: ast.Module, path: str, lines: list[str],
-    findings: list[Finding],
+    findings: list[Finding], external_coll: frozenset = frozenset(),
 ) -> None:
-    jitted, coll_fns, traced_defs = _multi_device_jits(tree)
+    jitted, coll_fns, traced_defs = _multi_device_jits(tree, external_coll)
     if not jitted and not coll_fns:
         return
     for loop in _loops_outside_traced(tree, traced_defs):
@@ -858,7 +867,8 @@ def _check_launch_storms(
                     ))
 
 
-def lint_source(source: str, path: str) -> list[Finding]:
+def lint_source(source: str, path: str, *,
+                external_coll: frozenset = frozenset()) -> list[Finding]:
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -882,7 +892,7 @@ def lint_source(source: str, path: str) -> list[Finding]:
         elif isinstance(node, ast.ClassDef):
             _check_leader_blocking_reads(node, class_map, path, lines,
                                          findings, reported)
-    _check_launch_storms(tree, path, lines, findings)
+    _check_launch_storms(tree, path, lines, findings, external_coll)
     return findings
 
 
@@ -890,7 +900,14 @@ def run_control_pass(
     root: str, *, paths: list[str] | None = None,
 ) -> list[Finding]:
     """Lint ``runtime/`` + ``serve/`` + ``gateway/`` + ``obs/`` (or
-    explicit ``paths``); labels are root-relative."""
+    explicit ``paths``); labels are root-relative. The whole tree under
+    ``root`` is indexed first (xmodule.CrossIndex) so GL-R305 sees
+    collective-bearing functions imported from modules outside the
+    linted set — e.g. a jitted wrapper in ``runtime/`` around a sync
+    helper defined in ``parallel/``."""
+    from tpu_sandbox.analysis import xmodule
+    from tpu_sandbox.analysis.collective_pass import iter_py_files
+
     if paths is None:
         paths = []
         for pkg in ("runtime", "serve", "gateway", "obs", "deploy"):
@@ -899,13 +916,25 @@ def run_control_pass(
                 for fn in sorted(os.listdir(pkg_dir)):
                     if fn.endswith(".py"):
                         paths.append(os.path.join(pkg_dir, fn))
+    # index every module the linted files could import from: the whole
+    # tree (minus fixture corpora) plus the explicit paths themselves
+    index_paths = set(iter_py_files(root, {"tests", "related"}))
+    index_paths.update(paths)
+    sources: dict[str, str] = {}
+    for p in index_paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                sources[p] = f.read()
+        except OSError:
+            continue
+    cross = xmodule.CrossIndex(root, sources)
     findings: list[Finding] = []
     for p in paths:
         rel = os.path.relpath(p, root)
-        try:
-            with open(p, "r", encoding="utf-8") as f:
-                src = f.read()
-        except OSError:
+        src = sources.get(p)
+        if src is None:
             continue
-        findings.extend(lint_source(src, rel))
+        findings.extend(lint_source(
+            src, rel,
+            external_coll=frozenset(cross.imported_coll_fns(p))))
     return findings

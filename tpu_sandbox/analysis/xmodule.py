@@ -1,0 +1,176 @@
+"""Cross-module call-graph resolution for the AST lint passes.
+
+Pass 1 and GL-R305 used to summarize one module at a time, so a
+collective hidden one ``import`` away was invisible: a rank-guarded call
+to ``helpers.sync_all()`` linted clean even though ``sync_all``'s body
+issues a ``pmean`` — exactly the divergence class the pass exists to
+catch (the PR-6 carry-over). This module closes that hole without
+importing any scanned code: it parses the whole file set, records each
+module's import aliases, and runs the "bears a collective" fixed point
+*globally*, so bearing propagates through ``from mod import helper`` and
+``import mod`` / ``mod.helper()`` edges of any depth.
+
+Scope, deliberately narrow (a lint heuristic, not an import system):
+
+- ``import pkg.mod as m`` + ``m.f()`` and ``from pkg.mod import f [as g]``
+  resolve; ``from mod import *`` and multi-dotted receivers
+  (``a.b.f()``) do not — unresolvable edges stay silent, never noisy.
+- Relative imports resolve against the importing module's package
+  (``from .helpers import f`` inside ``pkg/mod.py`` targets
+  ``pkg.helpers``).
+- Only module-level functions travel across module edges; classes and
+  methods resolve within their own module as before.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+
+def module_name(path: str, root: str) -> str:
+    """Dotted module name of ``path`` relative to ``root``:
+    ``<root>/tpu_sandbox/parallel/collectives.py`` ->
+    ``tpu_sandbox.parallel.collectives``. A package ``__init__.py`` names
+    the package itself."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.split(os.sep) if p not in (".", "")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def import_aliases(tree: ast.Module,
+                   modname: str = "") -> dict[str, tuple[str, str | None]]:
+    """Local alias -> (target module, remote name | None). ``None`` as
+    the remote name marks a module alias (``import helpers [as h]``);
+    a string marks a from-import of one name."""
+    aliases: dict[str, tuple[str, str | None]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname is not None:
+                    aliases[a.asname] = (a.name, None)
+                elif "." not in a.name:
+                    # `import a.b` binds `a`, and `a.b.f()` is a
+                    # multi-dotted receiver we don't chase anyway
+                    aliases[a.name] = (a.name, None)
+        elif isinstance(node, ast.ImportFrom):
+            target = node.module or ""
+            if node.level:
+                parts = modname.split(".") if modname else []
+                # level 1 = this module's package, each extra level one up
+                base = parts[:-node.level] if node.level <= len(parts) else []
+                target = ".".join(base + ([target] if target else []))
+            if not target:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = (target, a.name)
+    return aliases
+
+
+class CrossIndex:
+    """Per-module function indexes wired together across import edges.
+
+    Built from ``{path: source}``; unparseable files drop out (the pass
+    reports them through its own syntax-error finding). After
+    construction every module's ``_FunctionIndex.bearing`` reflects the
+    *global* fixed point, and its ``external`` hook answers for direct
+    call sites whose target lives in another scanned module — so the
+    per-module linters need no further changes."""
+
+    def __init__(self, root: str, sources: dict[str, str]):
+        # local import: collective_pass imports this module at top level
+        from tpu_sandbox.analysis.collective_pass import _FunctionIndex
+
+        self._by_path: dict[str, str] = {}
+        self.indexes: dict[str, object] = {}
+        self.aliases: dict[str, dict[str, tuple[str, str | None]]] = {}
+        for path, src in sources.items():
+            mod = module_name(path, root)
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            self._by_path[path] = mod
+            self.indexes[mod] = _FunctionIndex(tree)
+            self.aliases[mod] = import_aliases(tree, mod)
+        self._propagate()
+        for mod, idx in self.indexes.items():
+            idx.external = self._resolver_for(mod)
+
+    # -- querying -------------------------------------------------------------
+
+    def index_for(self, path: str):
+        """The (externally-wired) _FunctionIndex for ``path``, or None if
+        the file failed to parse."""
+        mod = self._by_path.get(path)
+        return None if mod is None else self.indexes.get(mod)
+
+    def imported_coll_fns(self, path: str) -> set[str]:
+        """Local alias names in ``path`` that are from-imports of
+        collective-bearing module-level functions elsewhere in the
+        scanned set — what GL-R305 unions into its ``coll_fns``."""
+        mod = self._by_path.get(path)
+        if mod is None:
+            return set()
+        out = set()
+        for alias, (tmod, tname) in self.aliases.get(mod, {}).items():
+            if tname is not None and self._target_bearing(tmod, tname):
+                out.add(alias)
+        return out
+
+    # -- resolution -----------------------------------------------------------
+
+    def _target_bearing(self, tmod: str, tname: str) -> bool:
+        idx = self.indexes.get(tmod)
+        return idx is not None and bool(idx.bearing.get(tname, False))
+
+    def _external_bearing(self, mod: str, recv: str | None,
+                          name: str) -> bool:
+        """Does a call ``recv.name()`` / ``name()`` from ``mod`` reach a
+        collective-bearing function in another scanned module?"""
+        amap = self.aliases.get(mod, {})
+        if recv is not None:
+            tgt = amap.get(recv)
+            # module alias only: `obj.f()` on a from-imported object is
+            # an ordinary method call, not a cross-module edge
+            if tgt is not None and tgt[1] is None:
+                return self._target_bearing(tgt[0], name)
+            return False
+        tgt = amap.get(name)
+        if tgt is not None and tgt[1] is not None:
+            return self._target_bearing(tgt[0], tgt[1])
+        return False
+
+    def _resolver_for(self, mod: str):
+        def resolve(recv: str | None, name: str) -> bool:
+            return self._external_bearing(mod, recv, name)
+        return resolve
+
+    def _propagate(self) -> None:
+        """Global bearing fixed point: local edges re-walk (already at
+        their local fixed point, so they converge immediately) and
+        import edges join the graph."""
+        changed = True
+        while changed:
+            changed = False
+            for mod, idx in self.indexes.items():
+                for key, (cls, _has, calls) in idx.facts.items():
+                    if idx.bearing.get(key, False):
+                        continue
+                    for via_self, recv, name in calls:
+                        local = idx.resolve(name, cls, via_self)
+                        if local:
+                            hit = any(idx.bearing.get(t, False)
+                                      for t in local)
+                        else:
+                            hit = (not via_self) and self._external_bearing(
+                                mod, recv, name)
+                        if hit:
+                            idx.bearing[key] = True
+                            changed = True
+                            break

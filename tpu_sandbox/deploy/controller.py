@@ -12,7 +12,10 @@ with nothing but the registry keys:
    on disk (``verify_step_dir``) FIRST: a torn or corrupt export gets a
    claim-once ``reject`` record and no replica is ever told about it.
    A clean candidate gets the claim-once ``rec`` begin record.
-2. **canary** — the first live replica (sorted tag order) receives a
+2. **canary** — the least-loaded live replica by fresh load report
+   (queued + active work; ties and report-less fleets fall back to tag
+   order; the choice is persisted in the rollout's phase records so it
+   neither flaps nor changes across controller failover) receives a
    ``swap`` command through its ``serve/cmd/<tag>`` mailbox (idempotent,
    re-sent with local patience until the replica's TTL load report acks
    the new version). Once acked, version-pinned traffic shares go up for
@@ -179,10 +182,31 @@ class DeployController:
             return self._leader_canary(seq, rec, prev, reports, tags)
         return self._leader_converge(phase, seq, rec, prev, reports, tags)
 
+    def _pick_canary(self, seq: int, reports: dict,
+                     tags: list[str]) -> str:
+        """The canary replica: least-loaded by its fresh load report
+        (queued + active work), ties and report-less fleets falling back
+        to tag order. Persisted in the rollout's phase records the first
+        time it is chosen, so the choice neither flaps between ticks as
+        load shifts nor changes under a controller failover mid-canary —
+        the successor swaps (and measures) the same replica. A persisted
+        canary whose report vanished (replica died) is re-chosen."""
+        raw = self.kv.try_get(k_ro(self.fleet, seq, "canary"))
+        if raw is not None:
+            tag = json.loads(raw).get("tag", "")
+            if tag in reports:
+                return tag
+        canary = min(tags, key=lambda t: (
+            int(reports[t].get("queue_depth", 0))
+            + int(reports[t].get("active", 0)), t))
+        self.kv.set(k_ro(self.fleet, seq, "canary"), json.dumps(
+            {"ver": seq, "tag": canary, "wall": self.clock()}))
+        return canary
+
     def _leader_canary(self, seq: int, rec: dict, prev: int,
                        reports: dict, tags: list[str]):
         cfg = self.cfg
-        canary = tags[0]
+        canary = self._pick_canary(seq, reports, tags)
         ack = int(reports[canary].get("ver", 0))
         if ack != seq:
             err = reports[canary].get("swap_error")

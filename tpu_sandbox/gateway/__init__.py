@@ -16,7 +16,11 @@ from tpu_sandbox.gateway.fleet import (DEFAULT_FLEET, FleetSpec,
 from tpu_sandbox.gateway.routing import (ReplicaView, admit, choose,
                                          feasible, fresh, match_depth,
                                          parse_report)
-from tpu_sandbox.gateway.server import Gateway, GatewayStats, live_gateways
+from tpu_sandbox.gateway.server import (Gateway, GatewayStats,
+                                        live_gateway_endpoints,
+                                        live_gateways)
+from tpu_sandbox.gateway.wire import (make_client_ssl_context,
+                                      make_server_ssl_context)
 
 __all__ = [
     "DEFAULT_FLEET",
@@ -33,7 +37,10 @@ __all__ = [
     "fleet_kv",
     "fleet_namespace",
     "fresh",
+    "live_gateway_endpoints",
     "live_gateways",
+    "make_client_ssl_context",
+    "make_server_ssl_context",
     "match_depth",
     "parse_report",
 ]

@@ -16,10 +16,31 @@ schema. Differences that exist because the door does:
 - retry and hedge go through the gateway ('C' clear + fresh 'S';
   'E' hedge), which re-routes with current fleet state — the retry of a
   shed request may land on a different replica than the original.
+
+**Failover**: the client takes a gateway *list* (``endpoints``) and
+treats every connection-shaped failure — connect refusal, mid-frame EOF,
+hello timeout, TLS handshake that dies under it — as "this gateway is
+gone, try the next", cycling with jittered backoff. Correctness across a
+failover leans on the same store the gateways share: verdict slots,
+claim markers, and queue entries all outlive any one gateway, so a
+reissued 'W'/'T'/'C'/'E' is exactly the same operation against the same
+state. The one op that is NOT blindly reissued is 'S': after a failover
+mid-submit the client first polls the verdict slot ('T') on the new
+gateway — a request whose verdict landed before the old gateway died is
+returned, never re-executed. (A submit that died *before* the verdict is
+reissued; re-enqueueing is harmless — replicas skip entries whose rid
+already has a result, and claim-once publication arbitrates any race.)
+
+**TLS**: pass ``tls=wire.make_client_ssl_context(ca_pem)`` and the
+socket is wrapped before the first frame — the shared-secret hello rides
+inside the encrypted channel. Auth rejection (ST_AUTH) is deterministic
+and never fails over: every gateway shares the secret, so the next one
+would only say no again.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import time
 from dataclasses import dataclass, field
@@ -56,32 +77,56 @@ class GatewayAuthError(GatewayError):
 
 
 class GatewayClient:
-    """One caller's connection to the gateway. Not thread-safe; make one
-    per caller thread (they share the gateway, not this socket)."""
+    """One caller's connection to the gateway fleet. Not thread-safe; make
+    one per caller thread (they share the gateways, not this socket).
 
-    def __init__(self, port: int, *, host: str = "127.0.0.1",
+    ``port`` keeps the single-gateway call sites working; HA callers pass
+    ``endpoints=[(host, port), ...]`` instead and the client fails over
+    down the list (wrapping around, jittered backoff between full
+    cycles). ``tls`` is an ``ssl.SSLContext`` from
+    :func:`wire.make_client_ssl_context`, applied to every connection."""
+
+    def __init__(self, port: int | None = None, *, host: str = "127.0.0.1",
                  token: str | None = None, fleet: str = "",
                  deadline_s: float | None = None, max_retries: int = 2,
                  hedge_after: float | None = None,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 endpoints: list[tuple[str, int]] | None = None,
+                 tls=None, failover_cycles: int = 3,
+                 backoff_base: float = 0.05, backoff_cap: float = 0.5):
+        if endpoints is None:
+            if port is None:
+                raise ValueError("need port or endpoints")
+            endpoints = [(host, int(port))]
+        if not endpoints:
+            raise ValueError("endpoints must not be empty")
         self.fleet = fleet
         self.deadline_s = deadline_s
         self.max_retries = max_retries
         self.hedge_after = hedge_after
+        self.connect_timeout = connect_timeout
+        self.failover_cycles = failover_cycles
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
         self.stats = ClientStats()
+        self._endpoints = [(str(h), int(p)) for h, p in endpoints]
+        self._idx = 0  # endpoint currently connected (or next to try)
+        self._tls = tls
+        self._token = token
+        self._rng = random.Random()  # backoff jitter only, never routing
         self._pending: dict[str, _Pending] = {}
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
-        if token is not None:
-            status, body = self._call(wire.OP_HELLO, {"token": token})
-            if status != wire.ST_OK:
-                self.close()
-                raise GatewayAuthError(body.get("error", "hello refused"))
+        self._sock: socket.socket | None = None
+        self._connect_any()
+
+    @property
+    def endpoint(self) -> tuple[str, int]:
+        """The gateway this client is currently connected to."""
+        return self._endpoints[self._idx]
 
     def close(self) -> None:
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
 
     def __enter__(self) -> "GatewayClient":
         return self
@@ -89,12 +134,98 @@ class GatewayClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- connection + failover -----------------------------------------------
+
+    def _connect_one(self, host: str, port: int) -> socket.socket:
+        """Connect + (optional) TLS wrap + hello, all under the connect
+        timeout — a gateway that accepts but never answers hello is as
+        dead as one that refuses the SYN."""
+        sock = socket.create_connection((host, port),
+                                       timeout=self.connect_timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._tls is not None:
+                sock = self._tls.wrap_socket(sock, server_hostname=host)
+            if self._token is not None:
+                wire.send_frame(sock, wire.OP_HELLO, {"token": self._token})
+                status, body = wire.recv_response(sock)
+                if status != wire.ST_OK:
+                    raise GatewayAuthError(
+                        body.get("error", "hello refused"))
+            sock.settimeout(None)
+            return sock
+        except BaseException:
+            sock.close()
+            raise
+
+    def _connect_any(self) -> None:
+        """Walk the endpoint list from the current index until one
+        connects; jittered backoff between full cycles. Auth rejection
+        raises immediately (deterministic — the next gateway holds the
+        same secret); only connection-shaped failures advance the walk."""
+        last: Exception | None = None
+        for cycle in range(self.failover_cycles):
+            for _ in range(len(self._endpoints)):
+                host, port = self._endpoints[self._idx]
+                try:
+                    self._sock = self._connect_one(host, port)
+                    return
+                except GatewayAuthError:
+                    raise
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    last = e
+                    self._idx = (self._idx + 1) % len(self._endpoints)
+            delay = min(self.backoff_cap,
+                        self.backoff_base * (2 ** cycle))
+            time.sleep(self._rng.uniform(0, delay))
+        raise GatewayError(
+            f"no gateway reachable (tried {self._endpoints} "
+            f"x{self.failover_cycles} cycles)") from last
+
+    def _failover(self) -> None:
+        self.close()
+        self._idx = (self._idx + 1) % len(self._endpoints)
+        self.stats.failovers += 1
+        get_registry().counter("client.failovers").inc()
+        self._connect_any()
+
     def _call(self, op: int, body: dict) -> tuple[int, dict]:
         wire.send_frame(self._sock, op, dict(body, fleet=self.fleet))
         return wire.recv_response(self._sock)
 
+    def _call_robust(self, op: int, body: dict) -> tuple[int, dict]:
+        """One op, surviving gateway death: connection-shaped failures
+        fail over and reissue. 'W'/'T'/'C'/'E' reissue verbatim (the
+        store state they act on outlives the gateway); 'S' first re-polls
+        the verdict slot so a request that already completed is never
+        re-executed."""
+        failed_over = False
+        budget = self.failover_cycles * len(self._endpoints)
+        while True:
+            try:
+                if failed_over and op == wire.OP_SUBMIT:
+                    status, verdict = self._call(
+                        wire.OP_TRY, {"rid": body["rid"]})
+                    if status == wire.ST_OK:
+                        # the old gateway died after the verdict landed;
+                        # surface it as an admit — result() finds it
+                        return wire.ST_OK, {
+                            "admitted": True,
+                            "replica": verdict.get("replica", ""),
+                            "depth": 0, "routed": "failover"}
+                return self._call(op, body)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                failed_over = True
+                budget -= 1
+                if budget < 0:
+                    # every reconnect succeeded but the op itself keeps
+                    # dying mid-frame — stop chasing a flapping fleet
+                    raise GatewayError(
+                        f"op {op} kept failing across failovers") from e
+                self._failover()
+
     def _checked(self, op: int, body: dict) -> tuple[int, dict]:
-        status, resp = self._call(op, body)
+        status, resp = self._call_robust(op, body)
         if status == wire.ST_ERR:
             raise GatewayError(resp.get("error", "gateway error"))
         if status == wire.ST_AUTH:

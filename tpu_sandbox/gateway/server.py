@@ -32,6 +32,20 @@ trips it performs per request are sub-millisecond against the local
 store, so handlers call them inline; only verdict *waits* yield the loop
 (``asyncio.sleep`` polling), keeping every other connection live while
 one blocks on a slow decode.
+
+**HA**: any number of gateways may front the same store — all shared
+state (load reports, verdict slots, claim markers) already lives in the
+KV store, and claim-once ``serve/done/<rid>`` arbitration makes
+concurrent door sheds, hedges, and clears race-safe by construction.
+Each gateway registers a TTL'd ``gateway/hb/<id>`` lease so clients and
+the chaos harness can discover the live set
+(:func:`live_gateway_endpoints`); a SIGKILLed gateway simply drops off
+that list when its lease lapses, and every request it routed is still
+claimable, scavengable, and verdict-bearing without it. Requests are
+stamped with the routing gateway's id (``write_request(..., gw=...)``)
+so replicas can attribute claims per gateway — the chaos claim audit's
+evidence that a killed gateway's in-flight work was finished by the
+fleet, not lost.
 """
 
 from __future__ import annotations
@@ -44,6 +58,7 @@ import os
 import random
 import signal
 import socket
+import ssl
 import sys
 import threading
 import time
@@ -74,6 +89,27 @@ def live_gateways() -> list["Gateway"]:
     return [g for g in _LIVE_GATEWAYS if not g.closed]
 
 
+def k_gateway_hb(gateway_id: str) -> str:
+    """The gateway's TTL'd liveness lease: value JSON {host, port, wall}."""
+    return f"gateway/hb/{gateway_id}"
+
+
+def live_gateway_endpoints(kv) -> list[tuple[str, str, int]]:
+    """(gateway_id, host, port) for every gateway whose heartbeat lease is
+    still live, sorted by id — the discovery surface a failover client or
+    the chaos harness reads instead of a static endpoint list. A SIGKILLed
+    gateway drops off when its lease TTL lapses; nothing deletes it."""
+    out = []
+    for key in kv.keys("gateway/hb/"):
+        raw = kv.try_get(key)
+        if raw is None:
+            continue  # lapsed between list and read
+        body = json.loads(raw)
+        out.append((key[len("gateway/hb/"):],
+                    str(body["host"]), int(body["port"])))
+    return sorted(out)
+
+
 @dataclass
 class GatewayStats:
     connections: int = 0
@@ -87,6 +123,7 @@ class GatewayStats:
     clears: int = 0
     auth_failures: int = 0
     protocol_errors: int = 0
+    tls_handshake_failures: int = 0
 
 
 @dataclass
@@ -134,7 +171,9 @@ class Gateway:
                  token: str | None = None, admission: str = "feasible",
                  policy: str = "prefix", policy_seed: int = 0,
                  max_report_age_s: float = 5.0,
-                 refresh_min_s: float = 0.02, wait_cap_s: float = 60.0):
+                 refresh_min_s: float = 0.02, wait_cap_s: float = 60.0,
+                 gateway_id: str | None = None, tls=None,
+                 hb_ttl: float = 3.0):
         specs = fleets or [FleetSpec(name=DEFAULT_FLEET)]
         names = [s.name for s in specs]
         if len(set(names)) != len(names):
@@ -159,12 +198,21 @@ class Gateway:
         self.max_report_age_s = max_report_age_s
         self.refresh_min_s = refresh_min_s
         self.wait_cap_s = wait_cap_s
+        # the HA identity: stamped into every routed request (gw field)
+        # and onto the gateway/hb/<id> liveness lease. The pid-derived
+        # default is unique enough for ad-hoc runs; HA fleets and chaos
+        # campaigns pass stable explicit ids.
+        self.gateway_id = gateway_id or f"gw-{os.getpid()}"
+        self._tls = tls  # ssl.SSLContext for the listener, or None
+        self.hb_ttl = hb_ttl
         self.stats = GatewayStats()
         self.port: int | None = None
         self.closed = False
+        self.killed = False
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._stop: asyncio.Event | None = None
+        self._writers: set = set()   # open connections, for abrupt kill()
         self._started = threading.Event()
         self._startup_error: BaseException | None = None
         _LIVE_GATEWAYS.add(self)
@@ -191,7 +239,34 @@ class Gateway:
             with contextlib.suppress(RuntimeError):  # loop already gone
                 self._loop.call_soon_threadsafe(self._stop.set)
             self._thread.join(timeout=5.0)
+        if not self.killed:
+            # a clean shutdown retires its lease immediately; a kill()
+            # leaves it to lapse, exactly like a SIGKILLed process would
+            with contextlib.suppress(ConnectionError, OSError):
+                self._kv.delete(k_gateway_hb(self.gateway_id))
         self._kv.close()
+
+    def kill(self) -> None:
+        """Die abruptly: drop every open connection mid-whatever, stop
+        answering, leave the heartbeat lease to TTL out — the in-process
+        stand-in for SIGKILL that chaos campaigns fire. Unlike
+        :meth:`close`, nothing is flushed or retired; clients see a
+        mid-frame EOF and must fail over."""
+        if self.closed:
+            return
+        self.killed = True
+        if self._loop is not None and self._thread is not None \
+                and self._thread.is_alive() and self._stop is not None:
+            def _abort() -> None:
+                for w in list(self._writers):
+                    with contextlib.suppress(Exception):
+                        transport = w.transport
+                        if transport is not None:
+                            transport.abort()
+                self._stop.set()
+            with contextlib.suppress(RuntimeError):
+                self._loop.call_soon_threadsafe(_abort)
+        self.close()
 
     def __enter__(self) -> "Gateway":
         return self
@@ -210,19 +285,59 @@ class Gateway:
     async def _amain(self) -> None:
         self._loop = asyncio.get_running_loop()
         self._stop = asyncio.Event()
+        # TLS handshake failures (plaintext probes, wrong-CA alerts, bad
+        # protocol versions) never reach _handle, and asyncio's sslproto
+        # only debug-logs them (SSLError is an OSError). The one hook that
+        # sees every failed handshake is the SSLObject the context builds —
+        # install a counting subclass bound to this gateway's stats. The
+        # context must therefore not be shared across gateways.
+        if self._tls is not None:
+            stats = self.stats
+
+            class _CountingSSLObject(ssl.SSLObject):
+                def do_handshake(sslobj) -> None:
+                    try:
+                        super().do_handshake()
+                    except (ssl.SSLWantReadError, ssl.SSLWantWriteError):
+                        raise  # handshake still in progress, not a failure
+                    except Exception:
+                        stats.tls_handshake_failures += 1
+                        raise
+
+            self._tls.sslobject_class = _CountingSSLObject
         server = await asyncio.start_server(
-            self._handle, self._host, self._requested_port)
+            self._handle, self._host, self._requested_port,
+            ssl=self._tls,
+            ssl_handshake_timeout=5.0 if self._tls is not None else None)
         self.port = server.sockets[0].getsockname()[1]
+        hb = asyncio.ensure_future(self._heartbeat())
         self._started.set()
-        async with server:
-            await self._stop.wait()
+        try:
+            async with server:
+                await self._stop.wait()
+        finally:
+            hb.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await hb
         # asyncio.run's shutdown cancels any still-open connection handlers
+
+    async def _heartbeat(self) -> None:
+        """Refresh the gateway/hb/<id> liveness lease on a half-TTL
+        cadence. The lease is discovery, not authority: losing it (or the
+        whole gateway) costs clients a failover, never a request."""
+        body = json.dumps({"host": self._host, "port": self.port,
+                           "wall": time.time()})
+        while True:
+            self._kv.set_ttl(k_gateway_hb(self.gateway_id), body,
+                             self.hb_ttl)
+            await asyncio.sleep(self.hb_ttl / 2)
 
     # -- connection handling -------------------------------------------------
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         self.stats.connections += 1
+        self._writers.add(writer)
         sock = writer.get_extra_info("socket")
         if sock is not None:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -259,6 +374,7 @@ class Gateway:
         finally:
             # a request exists only once its 'S' frame fully dispatched, so
             # closing here never strands one — it just ends the conversation
+            self._writers.discard(writer)
             writer.close()
             with contextlib.suppress(ConnectionError, OSError):
                 await writer.wait_closed()
@@ -462,7 +578,8 @@ class Gateway:
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             seed=int(body.get("seed", 0)),
-            tc=None if tc is None else tc.to_wire())
+            tc=None if tc is None else tc.to_wire(),
+            gw=self.gateway_id)
         if target is None:
             enqueue(fleet.kv, rid)
         else:
@@ -605,19 +722,32 @@ def main(argv=None) -> int:
     p.add_argument("--fleets", default=None,
                    help="JSON list of FleetSpec kwargs; default is the "
                         "single bare-namespace fleet")
+    p.add_argument("--gateway-id", default=None,
+                   help="stable HA identity for the gateway/hb lease and "
+                        "request stamping (default: gw-<pid>)")
+    p.add_argument("--tls-cert", default=None,
+                   help="server certificate PEM; with --tls-key, every "
+                        "external connection must speak TLS")
+    p.add_argument("--tls-key", default=None)
     args = p.parse_args(argv)
     if not args.kv_port:
         p.error(f"--kv-port or {ENV_KV_PORT} required")
+    if bool(args.tls_cert) != bool(args.tls_key):
+        p.error("--tls-cert and --tls-key go together")
     fleets = None
     if args.fleets:
         fleets = [FleetSpec(**f) for f in json.loads(args.fleets)]
+    tls = None
+    if args.tls_cert:
+        tls = wire.make_server_ssl_context(args.tls_cert, args.tls_key)
     kv = KVClient(port=args.kv_port)
     gw = Gateway(kv, fleets, host=args.host, port=args.port,
                  token=args.token, admission=args.admission,
-                 policy=args.policy)
+                 policy=args.policy, gateway_id=args.gateway_id, tls=tls)
     gw.start()
-    print(f"[gateway] listening on {args.host}:{gw.port} "
-          f"(admission={args.admission})", flush=True)
+    print(f"[gateway] {gw.gateway_id} listening on {args.host}:{gw.port} "
+          f"(admission={args.admission}, "
+          f"tls={'on' if tls is not None else 'off'})", flush=True)
     stopped = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stopped.set())

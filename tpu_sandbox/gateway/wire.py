@@ -31,6 +31,18 @@ unknown op, auth failure — closes the connection; it never wedges the
 accept loop or leaks a request (a request exists only after a fully
 parsed, fully dispatched 'S').
 
+TLS rides *under* this framing on the external wire: the gateway wraps
+its listener in an ``ssl.SSLContext`` and the client wraps its socket
+before the first frame, so the shared-secret hello (and everything after
+it) is inside the encrypted channel. The framing code below is transport
+agnostic — an ``ssl.SSLSocket`` and an ssl-wrapped asyncio stream expose
+the same recv/readexactly surface — which is why the context builders
+live here next to the protocol they protect. A plaintext client against
+a TLS gateway fails the *handshake* (the server reads a frame header out
+of the ClientHello bytes, or the client times out waiting for a
+ServerHello that never parses); either way the connection dies before a
+single op is interpreted.
+
 Both ends set TCP_NODELAY: frames are small and latency is the product.
 """
 
@@ -38,6 +50,7 @@ from __future__ import annotations
 
 import json
 import socket
+import ssl
 import struct
 
 #: one-frame cap, matching the kvstore's sanity cap in spirit; prompts are
@@ -67,6 +80,32 @@ ST_AUTH = 4      # hello rejected / required and absent
 
 class ProtocolError(Exception):
     """The peer violated the framing contract; close the connection."""
+
+
+# -- TLS contexts -------------------------------------------------------------
+
+
+def make_server_ssl_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    """The gateway's listener context: TLS 1.2+, server cert + key from
+    committed PEM files (tests/fixtures/tls/ in the suite; an operator
+    hands real paths in production). Client certs are not requested —
+    the shared-secret hello inside the channel is the caller identity."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
+    return ctx
+
+
+def make_client_ssl_context(cafile: str) -> ssl.SSLContext:
+    """The client's context: verify the gateway against exactly the CA
+    given (never the system trust store — a sandbox fleet's CA is
+    private), hostname checking on."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_verify_locations(cafile=cafile)
+    ctx.check_hostname = True
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
 
 
 def pack_frame(op: int, payload: bytes) -> bytes:

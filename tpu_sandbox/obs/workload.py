@@ -31,6 +31,7 @@ outcome), so export is a pure function of the merged record list.
 from __future__ import annotations
 
 import json
+import random
 
 from tpu_sandbox.obs import critpath
 
@@ -91,6 +92,42 @@ def from_trace(merged: list[dict], *, source: str = "") -> dict:
         row["t_s"] = round(row["t_s"] - base, 6)
     rows.sort(key=lambda r: (r["t_s"], r["rid"]))
     return {"schema": SCHEMA, "source": source, "requests": rows}
+
+
+def synthesize(seed: int, n: int, *, duration_s: float = 1.0,
+               fleet: str = "", n_chains: int = 4,
+               prompt_tokens: tuple[int, int] = (12, 48),
+               decode_tokens: tuple[int, int] = (4, 16),
+               deadline_s: float | None = None) -> dict:
+    """A seeded canonical workload: ``n`` arrivals over ``duration_s``,
+    each tagged with one of ``n_chains`` shared prefix chains (so a
+    replayed fleet has real prefix-affinity structure to route on). Same
+    seed, same trace, byte for byte — the chaos harness replays these
+    against a live fleet and compares audits across runs, which only
+    means something if the input side is pinned. Outcomes are ``open``:
+    a synthesized trace records what arrives, not how a fleet will
+    answer it."""
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "t_s": round(rng.uniform(0.0, duration_s), 6),
+            "rid": f"c{seed}-r{i:04d}",
+            "tenant": fleet or "default",
+            "fleet": fleet or "default",
+            "chain": f"chain{rng.randrange(n_chains)}",
+            "prompt_tokens": rng.randint(*prompt_tokens),
+            "decode_tokens": rng.randint(*decode_tokens),
+            "outcome": "open",
+            "deadline_s": deadline_s,
+        })
+    rows.sort(key=lambda r: (r["t_s"], r["rid"]))
+    # rebase so the first arrival is t=0, like a from_trace export
+    base = rows[0]["t_s"] if rows else 0.0
+    for row in rows:
+        row["t_s"] = round(row["t_s"] - base, 6)
+    return {"schema": SCHEMA, "source": f"synthesized:seed={seed}",
+            "requests": rows}
 
 
 def dumps(trace: dict) -> str:

@@ -17,11 +17,21 @@
   publication is claim-once (serve/done/<rid>) and result bodies are
   bitwise identical across executions (greedy or seeded-sampled decode),
   so a hedge can only waste compute, never change an answer.
+
+Direct-to-KV traffic honors the canary split too: when the deploy
+controller has live traffic shares up (``deploy/shares/<fleet>``), each
+submit draws a weight version by share and writes the ``serve/pin``
+before enqueueing, so the first claimer decodes on the drawn version.
+Without this, KV-path requests would all land on whatever version their
+claimer happens to run — diluting the canary's share of traffic and the
+baseline-vs-canary comparison both. A retry redraws (it is a new
+lifecycle; the pin was cleared with the verdict).
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
 from dataclasses import dataclass, field
 
@@ -37,6 +47,9 @@ class ClientStats:
     shed: int = 0
     retries: int = 0
     hedges: int = 0
+    #: gateway-path only: connections moved to another gateway after a
+    #: connect failure / mid-frame EOF / hello timeout
+    failovers: int = 0
 
 
 class RetriesExhausted(RuntimeError):
@@ -76,7 +89,8 @@ class ServeClient:
 
     def __init__(self, kv, *, deadline_s: float | None = None,
                  max_retries: int = 2, hedge_after: float | None = None,
-                 backoff_base: float = 0.02, backoff_cap: float = 0.5):
+                 backoff_base: float = 0.02, backoff_cap: float = 0.5,
+                 share_seed: int = 0):
         self.kv = kv
         self.deadline_s = deadline_s
         self.max_retries = max_retries
@@ -85,6 +99,35 @@ class ServeClient:
         self.backoff_cap = backoff_cap
         self.stats = ClientStats()
         self._pending: dict[str, _Pending] = {}
+        # canary-share draws: seeded so a test or replay submits the same
+        # version sequence; the deploy keys live at the store ROOT while
+        # a fleet view's serve keys live under fleet/<name>/
+        self._share_rng = random.Random(share_seed)
+        prefix = getattr(kv, "prefix", "") or ""
+        if prefix.startswith("fleet/") and prefix.endswith("/"):
+            self._deploy_kv = kv.raw
+            self._fleet = prefix[len("fleet/"):-1]
+        else:
+            self._deploy_kv = kv
+            self._fleet = ""
+
+    def _pin_share(self, rid: str) -> int | None:
+        """Honor the deploy controller's live canary split: draw a weight
+        version by share and pin the rid to it BEFORE the enqueue, so the
+        first claimer decodes on the drawn version. No live shares (the
+        overwhelmingly common case) costs one try_get. Never a
+        correctness gate — a pin the fleet cannot serve yet just decodes
+        on the pinned version once a replica acks it."""
+        from tpu_sandbox.deploy.registry import read_shares
+        from tpu_sandbox.gateway.routing import pick_by_share
+
+        shares = read_shares(self._deploy_kv, self._fleet)
+        if not shares:
+            return None
+        ver = pick_by_share(shares, self._share_rng.random())
+        if ver is not None:
+            self.kv.set(k_pin(rid), str(int(ver)))
+        return ver
 
     def submit(self, rid: str, prompt, max_new_tokens: int, *,
                deadline_s: float | None = None, temperature: float = 0.0,
@@ -96,6 +139,7 @@ class ServeClient:
                      submitted_at=time.time(),
                      retries_left=self.max_retries)
         p.attempts.append({"submitted_at": p.submitted_at})
+        self._pin_share(rid)
         submit_request(
             self.kv, rid, p.prompt, p.max_new_tokens,
             deadline_unix=None if d is None else p.submitted_at + d,
@@ -161,6 +205,7 @@ class ServeClient:
         self.kv.delete(k_result(rid))
         self.kv.delete(k_done(rid))
         self.kv.delete(k_pin(rid))
+        self._pin_share(rid)  # a retry redraws the canary split
         submit_request(
             self.kv, rid, p.prompt, p.max_new_tokens,
             deadline_unix=None if p.deadline_s is None

@@ -140,16 +140,20 @@ def k_tq_scavenged(tag: str, seq: int) -> str:
 def write_request(kv, rid: str, prompt: Sequence[int],
                   max_new_tokens: int, *, deadline_unix: float | None = None,
                   temperature: float = 0.0, top_k: int = 0,
-                  seed: int = 0, tc: dict | None = None) -> None:
+                  seed: int = 0, tc: dict | None = None,
+                  gw: str | None = None) -> None:
     """Write the request body without enqueueing — the gateway writes the
     body once, then targets the entry at the replica routing chose.
     ``deadline_unix`` is wall clock (``time.time()``) so it survives the
     hop between client and replica processes; replicas translate it to
     their engine clock at claim time. ``tc`` is the submit trace context
     (``TraceContext.to_wire()``); it rides the body so the claim span can
-    chain to the gateway's enqueue span. The body is written exactly once
-    per rid either way, so adding the key never perturbs the
-    idempotent-verdict contract."""
+    chain to the gateway's enqueue span. ``gw`` is the routing gateway's
+    HA identity; replicas count claims per gateway in their load reports
+    so the chaos claim audit can show a killed gateway's in-flight work
+    being finished by the fleet. The body is written exactly once per rid
+    either way, so adding these keys never perturbs the idempotent-
+    verdict contract."""
     body = {"rid": rid, "prompt": list(map(int, prompt)),
             "max_new_tokens": int(max_new_tokens)}
     if deadline_unix is not None:
@@ -159,6 +163,8 @@ def write_request(kv, rid: str, prompt: Sequence[int],
                     seed=int(seed))
     if tc is not None:
         body["tc"] = tc
+    if gw is not None:
+        body["gw"] = str(gw)
     kv.set(k_req(rid), json.dumps(body))
 
 
@@ -268,6 +274,7 @@ class ReplicaWorker:
         self.scavenge_interval = scavenge_interval or lease_ttl
         self.load_interval = load_interval or lease_ttl / 2
         self._scanned = 0
+        self._gw_claims: dict[str, int] = {}  # routing gateway -> claims
         self._tq_scanned = 0  # cursor into our own targeted queue
         self._tq_hole_slot = -1   # targeted slot seen tail-bumped but empty
         self._tq_hole_since = 0.0
@@ -366,6 +373,12 @@ class ReplicaWorker:
         if self.kv.add(claim_key) != 1:
             return False
         body = json.loads(self.kv.get(k_req(rid)))
+        # per-gateway claim attribution for the HA/chaos claim audit: a
+        # request stamped by a since-killed gateway showing up here is the
+        # fleet finishing that gateway's in-flight work
+        gw = body.get("gw")
+        if gw is not None:
+            self._gw_claims[gw] = self._gw_claims.get(gw, 0) + 1
         # a rid can come around again legitimately: a client that saw
         # our SHED verdict cleared it and re-enqueued. Forget that we
         # published, so the fresh execution's verdict goes out too
@@ -663,6 +676,8 @@ class ReplicaWorker:
         self._next_load = now + self.load_interval
         report = dict(self.engine.load_report(), tag=self.tag,
                       wall=time.time())
+        if self._gw_claims:
+            report["gw_claims"] = dict(sorted(self._gw_claims.items()))
         if self._swap_error is not None:
             report["swap_error"] = self._swap_error
         self.kv.set_ttl(k_load(self.tag), json.dumps(report),
