@@ -1,0 +1,27 @@
+"""Seeded multi-dotted-receiver violations — every pattern here must be
+FLAGGED when linted TOGETHER with ``xpkg/helpers.py``. Before the
+longest-prefix receiver resolution, ``pkg.mod.fn()`` receivers were
+opaque to CrossIndex and this whole file read clean — that asymmetry is
+the regression this fixture pins.
+"""
+
+import xpkg.helpers
+import xpkg as xp
+
+
+def rank_branch_dotted_attr(tree, rank, axis):  # GL-C103
+    if rank == 0:
+        tree = xpkg.helpers.sync_all(tree, axis)  # pmean behind pkg.mod
+    return tree
+
+
+def rank_branch_alias_sub(tree, process_index, axis):  # GL-C103
+    if process_index == 0:
+        tree = xp.helpers.sync_all(tree, axis)  # alias + submodule hop
+    return tree
+
+
+def rank_exit_then_dotted_chain(tree, rank, axis):  # GL-C102
+    if rank != 0:
+        return tree  # other ranks bail...
+    return xpkg.helpers.sync_step(tree, axis)  # ...depth-2 + dotted edge

@@ -1,0 +1,21 @@
+"""The guarded twin of ``bad_xdotted.py`` — same imports, same dotted
+receivers, zero findings. Every rank collapses onto the same dotted
+collective, and the rank-guarded call reaches only a collective-free
+function through the very same ``pkg.mod.fn`` shape.
+"""
+
+import xpkg.helpers
+import xpkg as xp
+
+
+def all_ranks_dotted_sync(tree, rank, axis):
+    tree = xpkg.helpers.sync_all(tree, axis)  # unconditional
+    if rank == 0:
+        # rank-guarded but collective-free, through the dotted receiver:
+        # resolution must prove absence too, not just presence
+        tree = xpkg.helpers.plain_scale(tree, 1.0)
+    return tree
+
+
+def all_ranks_alias_sub(tree, axis):
+    return xp.helpers.sync_step(tree, axis)

@@ -1,0 +1,3 @@
+"""Package shell for the multi-dotted-receiver fixtures: gives
+``xpkg.helpers`` its dotted module name under the fixture root. Never
+imported by the tests; only ever parsed."""

@@ -1,0 +1,22 @@
+"""Collective-bearing helpers behind a PACKAGE path — the fixtures call
+these through multi-dotted receivers (``xpkg.helpers.sync_all()``),
+which CrossIndex resolves by longest import-alias prefix.
+
+Clean on its own: every collective here runs unconditionally."""
+
+from jax import lax
+
+
+def sync_all(tree, axis):
+    return lax.pmean(tree, axis)
+
+
+def sync_step(tree, axis):
+    # depth-2 chain: bearing must propagate locally before the dotted
+    # receiver crosses the import edge
+    return sync_all(tree, axis)
+
+
+def plain_scale(tree, factor):
+    # no collective anywhere below this: calls to it must never flag
+    return {k: v * factor for k, v in tree.items()}

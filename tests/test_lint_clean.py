@@ -126,6 +126,35 @@ def test_cross_module_clean_twin_passes():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_cross_module_dotted_receivers_fire():
+    """``pkg.mod.fn()`` and ``alias.submodule.fn()`` receivers resolve by
+    longest import-alias prefix — the PR-19 remainder. Both rank-gated
+    dotted spellings fire, and the depth-2 chain crosses the dotted
+    edge after a rank exit."""
+    paths = _xmodule_paths(os.path.join("xpkg", "helpers.py"),
+                          "bad_xdotted.py")
+    findings = run_collective_pass(FIXTURES, paths=paths)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {"GL-C102", "GL-C103"}, \
+        [f.format() for f in findings]
+    assert len(by_rule["GL-C103"]) == 2
+    assert all("sync_all" in f.message for f in by_rule["GL-C103"])
+    assert "sync_step" in by_rule["GL-C102"][0].message
+    assert all(f.file.endswith("bad_xdotted.py") for f in findings)
+
+
+def test_cross_module_dotted_clean_twin_passes():
+    """Same dotted receivers, unconditional (or collective-free): the
+    resolution must prove absence as well as presence."""
+    paths = _xmodule_paths(os.path.join("xpkg", "helpers.py"),
+                          "clean_xdotted.py")
+    findings = run_collective_pass(FIXTURES, paths=paths) \
+        + run_control_pass(FIXTURES, paths=paths)
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_cross_module_bad_file_reads_clean_alone():
     """Single-file lint cannot see through imports — the asymmetry that
     makes the whole-set run the only honest gate. If this starts firing,

@@ -153,6 +153,129 @@ def test_two_stage_pipeline_stage_kill_recovers_bitwise(tmp_path, reference):
     assert any(k.startswith("2/") for k in audit["claims"])
 
 
+# ---------------------------------------------------------------------------
+# 3 stages, uneven layer split, ZB-H1 split backward (the fast-fabric
+# tentpole at process level): same fault matrix, same receipts
+# ---------------------------------------------------------------------------
+
+N3 = 3
+LAYER_SPLIT = [2, 1, 1]  # uneven on purpose: stage 0 carries half the net
+KIND3 = "zb_h1"
+
+
+@pytest.fixture(scope="module")
+def reference3():
+    """Unfaulted in-process 3-stage ZB-H1 twin over the same uneven
+    split — the bitwise target for the process-level runs below."""
+    import optax
+
+    from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
+    from tpu_sandbox.mpmd import MPMDPipeline
+
+    cfg = TransformerConfig(**MODEL)
+    rng = np.random.default_rng(SEED)
+    tokens = rng.integers(0, cfg.vocab_size, size=tuple(BATCH)).astype(
+        np.int32)
+    targets = ((tokens + 7) % cfg.vocab_size).astype(np.int32)
+    flat = jax.tree.map(
+        np.asarray,
+        TransformerLM(cfg).init(jax.random.key(SEED), tokens)["params"])
+    pipe = MPMDPipeline(cfg, optax.adam(OPTIMIZER["lr"]), n_stages=N3,
+                        microbatches=M, kind=KIND3, layer_split=LAYER_SPLIT)
+    pipe.init_from_flat(flat)
+    losses = pipe.train(STEPS, tokens, targets)
+    return {
+        "losses": losses,
+        "stage_leaves": {
+            s: [np.asarray(x) for x in
+                jax.tree.leaves(pipe.workers[s].host_state()["params"])]
+            for s in range(N3)
+        },
+    }
+
+
+def _stage_argv3(stage, ckpt_root):
+    argv = [PY, "-m", "tpu_sandbox.mpmd.worker",
+            "{agent_id}", "{kv_port}", "{job_id}",
+            "--stage", str(stage), "--ckpt-root", str(ckpt_root),
+            "--get-timeout", "120"]
+    if stage == 0:
+        argv += ["--steps", str(STEPS), "--n-stages", str(N3),
+                 "--microbatches", str(M), "--seed", str(SEED),
+                 "--schedule-kind", KIND3,
+                 "--layer-split", _json_arg(LAYER_SPLIT),
+                 "--model", _json_arg(MODEL),
+                 "--optimizer", _json_arg(OPTIMIZER),
+                 "--batch", _json_arg(BATCH)]
+    return argv
+
+
+def _run_pipeline3(tmp_path, fault_env, fault_stage):
+    with ClusterScheduler(N3, poll=0.05, extra_env=ENV,
+                          verbose=False) as sched:
+        for s in range(N3):
+            sched.submit(JobSpec(
+                job_id=f"stage{s}", hosts=1, world_size=1, cogroup="pipe0",
+                agent_argv=_stage_argv3(s, tmp_path / "ckpt"),
+                admission_timeout=120.0,
+                env=fault_env if s == fault_stage else {}))
+        states = sched.serve(timeout=300)
+        assert states == {f"stage{s}": "done" for s in range(N3)}, states
+
+        from tpu_sandbox.mpmd.transport import KVTransport
+
+        tr = KVTransport(sched.kv, prefix="mpmd/pipe0/")
+        finals = {s: tr.get("final", 0, s, timeout=10.0) for s in range(N3)}
+        losses = json.loads(sched.kv.get("mpmd/pipe0/losses"))
+        audit = tr.audit()
+        generations = {s: int(sched.kv.get(f"mpmd/pipe0/gen/{s}"))
+                       for s in range(N3)}
+    return finals, losses, audit, generations
+
+
+def _assert_bitwise3(reference3, finals):
+    for s in range(N3):
+        ref, got = reference3["stage_leaves"][s], finals[s]
+        assert len(ref) == len(got)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes(), \
+                f"stage {s} leaf {i} differs from unfaulted run"
+
+
+def test_three_stage_zb_uneven_kill_midstream_recovers_bitwise(
+        tmp_path, reference3):
+    """The tentpole at process level: 3 stages on an uneven [2,1,1]
+    split under the ZB-H1 schedule, with the MIDDLE stage SIGKILLed
+    mid-schedule — half its B cotangents shipped, its deferred W reserve
+    un-run. Respawn + durable slots + generation bump must land bitwise
+    with zero duplicate claims."""
+    plan = FaultPlan().add(rank=1, step=3, action="kill_agent")
+    finals, losses, audit, gens = _run_pipeline3(
+        tmp_path, {"TPU_SANDBOX_FAULT_PLAN": plan.to_json()}, fault_stage=1)
+    _assert_bitwise3(reference3, finals)
+    np.testing.assert_allclose(losses, reference3["losses"], rtol=0,
+                               atol=1e-6)
+    assert gens == {0: 1, 1: 2, 2: 1}
+    dup = {k: v for k, v in audit["claims"].items() if v != 1}
+    assert not dup, f"duplicate deliveries: {dup}"
+    assert any(k.startswith("2/") for k in audit["claims"])
+
+
+def test_three_stage_zb_uneven_partition_heals_in_place(tmp_path,
+                                                        reference3):
+    plan = FaultPlan().add(rank=2, step=2, action="partition_host",
+                           target="1.5")
+    finals, losses, audit, gens = _run_pipeline3(
+        tmp_path, {"TPU_SANDBOX_FAULT_PLAN": plan.to_json()}, fault_stage=2)
+    _assert_bitwise3(reference3, finals)
+    np.testing.assert_allclose(losses, reference3["losses"], rtol=0,
+                               atol=1e-6)
+    assert gens == {0: 1, 1: 1, 2: 1}  # healed with no relaunch
+    dup = {k: v for k, v in audit["claims"].items() if v != 1}
+    assert not dup, f"duplicate deliveries: {dup}"
+
+
 def test_two_stage_pipeline_partition_heals_without_relaunch(tmp_path,
                                                              reference):
     """partition_host silences stage 1's heartbeats and stalls it for

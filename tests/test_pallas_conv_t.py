@@ -76,6 +76,7 @@ def test_grads_match_reference():
         )
 
 
+@pytest.mark.slow  # tier-1 keeps test_pallas_conv.py::test_grads_bf16
 def test_grads_bf16():
     """bf16 grads against the F32-computed truth (the lax.conv reference
     accumulates in bf16 and is not a valid oracle — see test_pallas_conv
@@ -97,6 +98,7 @@ def test_grads_bf16():
         )
 
 
+@pytest.mark.slow  # tier-1 keeps test_pallas_conv.py::test_stats_variant
 @pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16])
 def test_stats_variant(dt):
     """Same y; sum/sumsq equal the reductions of the ROUNDED output over

@@ -33,8 +33,16 @@ from tools.aot_v5e import make_topology, unwrap_cost  # noqa: E402
 def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
                     vocab_size: int = 8192, d_model: int = 256,
                     n_layers: int = 8, n_heads: int = 8, d_ff: int = 1024,
-                    batch: int = 32, seqlen: int = 128) -> dict:
-    """Compile every stage's programs chiplessly; returns the receipt."""
+                    batch: int = 32, seqlen: int = 128,
+                    layer_split: list[int] | None = None,
+                    zb: bool = False) -> dict:
+    """Compile every stage's programs chiplessly; returns the receipt.
+
+    ``layer_split`` compiles an uneven pipeline (per-stage layer counts);
+    ``zb`` lowers the ZB-H1 split backward (bwd_input / bwd_weight as
+    separate executables) instead of the fused one, so the receipt shows
+    what each half actually costs — the numbers ``schedule.autotune_plan``
+    trades against."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -42,7 +50,11 @@ def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from tpu_sandbox.models.transformer import TransformerConfig, TransformerLM
-    from tpu_sandbox.mpmd.program import StageProgram, stage_params
+    from tpu_sandbox.mpmd.program import (
+        StageProgram,
+        check_layer_split,
+        stage_params,
+    )
     from tpu_sandbox.mpmd.schedule import bubble_fraction
 
     topo = make_topology()
@@ -66,10 +78,12 @@ def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
         return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype,
                                     sharding=sh)
 
+    split = check_layer_split(n_layers, n_stages, layer_split)
     stages = []
     for s in range(n_stages):
-        prog = StageProgram(cfg, tx, s, n_stages, microbatches)
-        sp = stage_params(flat, s, n_stages)
+        prog = StageProgram(cfg, tx, s, n_stages, microbatches,
+                            layer_split=layer_split)
+        sp = stage_params(flat, s, n_stages, layer_split=layer_split)
         absp = jax.tree.map(sharded_like, sp)
         if prog.is_first:
             x = jax.ShapeDtypeStruct((mb_rows, seqlen), jnp.int32,
@@ -80,7 +94,7 @@ def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
         targets = jax.ShapeDtypeStruct((mb_rows, seqlen), jnp.int32,
                                        sharding=sh)
         lowered = prog.lower_train_programs(
-            absp, x, targets if prog.is_last else None)
+            absp, x, targets if prog.is_last else None, zb=zb)
         programs = {}
         for name, low in lowered.items():
             compiled = low.compile()
@@ -96,7 +110,7 @@ def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
             int(np.asarray(leaf).nbytes) for leaf in jax.tree.leaves(sp))
         stages.append({
             "stage": s,
-            "layers_local": n_layers // n_stages,
+            "layers_local": split[s],
             "param_bytes": param_bytes,
             "has_embedding": "pre" in sp,
             "has_head": "post" in sp,
@@ -110,6 +124,7 @@ def mpmd_aot_report(*, n_stages: int = 4, microbatches: int = 4,
             "vocab_size": vocab_size, "d_model": d_model,
             "n_layers": n_layers, "n_heads": n_heads, "d_ff": d_ff,
             "batch": batch, "seqlen": seqlen,
+            "layer_split": split, "zb": zb,
         },
         "bubble_fraction": bubble_fraction(n_stages, microbatches),
         "stages": stages,
@@ -136,12 +151,20 @@ def main():
     p.add_argument("--d-ff", type=int, default=1024)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--seqlen", type=int, default=128)
+    p.add_argument("--layer-split", default="",
+                   help="json list of per-stage layer counts, e.g. [3,3,2]")
+    p.add_argument("--zb", action="store_true",
+                   help="lower the ZB-H1 split backward "
+                   "(bwd_input/bwd_weight) instead of the fused one")
     args = p.parse_args()
     print(json.dumps(mpmd_aot_report(
         n_stages=args.n_stages, microbatches=args.microbatches,
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
-        batch=args.batch, seqlen=args.seqlen)))
+        batch=args.batch, seqlen=args.seqlen,
+        layer_split=(json.loads(args.layer_split)
+                     if args.layer_split else None),
+        zb=args.zb)))
 
 
 if __name__ == "__main__":

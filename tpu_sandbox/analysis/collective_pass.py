@@ -89,14 +89,22 @@ def _via_self(func: ast.AST) -> bool:
 
 
 def _recv_name(func: ast.AST) -> str | None:
-    """Single-name receiver of an attribute call: ``helpers.sync()`` ->
-    'helpers'. None for bare names, ``self.``, and dotted receivers —
-    only this shape can be an imported-module alias."""
-    if isinstance(func, ast.Attribute) \
-            and isinstance(func.value, ast.Name) \
-            and func.value.id != "self":
-        return func.value.id
-    return None
+    """Dotted receiver of an attribute call: ``helpers.sync()`` ->
+    'helpers', ``pkg.mod.fn()`` -> 'pkg.mod'. None for bare names,
+    anything rooted at ``self``, and non-name roots (call results,
+    subscripts) — only a plain name chain can be an imported-module
+    path, which xmodule resolves by longest alias prefix."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    parts: list[str] = []
+    node = func.value
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name) or node.id == "self":
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
 
 
 def _base_name(expr: ast.AST) -> str | None:
@@ -181,8 +189,8 @@ class _FunctionIndex:
     def _direct_facts(self, fn: ast.AST) -> tuple[bool, set]:
         """(has a literal collective, (via_self, recv, name) of calls it
         makes) — counting only this function's own body, not nested
-        defs. ``recv`` is the single-name attribute receiver (the only
-        shape that can be an imported-module alias), else None."""
+        defs. ``recv`` is the dotted name-chain attribute receiver (the
+        only shape that can be an imported-module path), else None."""
         has = False
         calls: set[tuple[bool, str | None, str]] = set()
         for node in ast.walk(fn):

@@ -12,9 +12,12 @@ module's import aliases, and runs the "bears a collective" fixed point
 
 Scope, deliberately narrow (a lint heuristic, not an import system):
 
-- ``import pkg.mod as m`` + ``m.f()`` and ``from pkg.mod import f [as g]``
-  resolve; ``from mod import *`` and multi-dotted receivers
-  (``a.b.f()``) do not — unresolvable edges stay silent, never noisy.
+- ``import pkg.mod as m`` + ``m.f()``, ``from pkg.mod import f [as g]``,
+  and multi-dotted receivers over plain name chains (``import pkg.mod``
+  + ``pkg.mod.f()``, ``import pkg.mod as m`` + ``m.sub.f()``) resolve by
+  longest alias prefix; ``from mod import *`` and receivers rooted at
+  anything but a name do not — unresolvable edges stay silent, never
+  noisy.
 - Relative imports resolve against the importing module's package
   (``from .helpers import f`` inside ``pkg/mod.py`` targets
   ``pkg.helpers``).
@@ -52,9 +55,12 @@ def import_aliases(tree: ast.Module,
             for a in node.names:
                 if a.asname is not None:
                     aliases[a.asname] = (a.name, None)
-                elif "." not in a.name:
-                    # `import a.b` binds `a`, and `a.b.f()` is a
-                    # multi-dotted receiver we don't chase anyway
+                else:
+                    # `import a.b` binds `a` at runtime, but the only
+                    # receiver shape that reaches a.b's functions is the
+                    # full dotted path `a.b.f()` — key the alias by the
+                    # dotted name; _external_bearing matches receivers
+                    # by longest alias prefix
                     aliases[a.name] = (a.name, None)
         elif isinstance(node, ast.ImportFrom):
             target = node.module or ""
@@ -135,11 +141,20 @@ class CrossIndex:
         collective-bearing function in another scanned module?"""
         amap = self.aliases.get(mod, {})
         if recv is not None:
-            tgt = amap.get(recv)
-            # module alias only: `obj.f()` on a from-imported object is
-            # an ordinary method call, not a cross-module edge
-            if tgt is not None and tgt[1] is None:
-                return self._target_bearing(tgt[0], name)
+            # longest alias prefix wins: `pkg.mod.f()` resolves through
+            # `import pkg.mod` (alias key 'pkg.mod'); `m.sub.f()` through
+            # `import pkg.mod as m` (alias 'm' + remainder '.sub')
+            parts = recv.split(".")
+            for cut in range(len(parts), 0, -1):
+                tgt = amap.get(".".join(parts[:cut]))
+                if tgt is None:
+                    continue
+                # module alias only: `obj.f()` on a from-imported object
+                # is an ordinary method call, not a cross-module edge
+                if tgt[1] is not None:
+                    return False
+                return self._target_bearing(
+                    ".".join([tgt[0], *parts[cut:]]), name)
             return False
         tgt = amap.get(name)
         if tgt is not None and tgt[1] is not None:

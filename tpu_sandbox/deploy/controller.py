@@ -18,7 +18,10 @@ with nothing but the registry keys:
    neither flaps nor changes across controller failover) receives a
    ``swap`` command through its ``serve/cmd/<tag>`` mailbox (idempotent,
    re-sent with local patience until the replica's TTL load report acks
-   the new version). Once acked, version-pinned traffic shares go up for
+   the new version; the replica stages the artifact chunk-streamed —
+   ``runtime.staging`` via ``registry.load_step_params`` — after its
+   verify-before-touch checksum pass, so a swap never doubles host
+   memory mid-roll). Once acked, version-pinned traffic shares go up for
    the gateway (``deploy/shares/<fleet>``) and two
    :class:`~tpu_sandbox.obs.health.BaselineDeltaRule` instances compare
    the canary's p99 TTFT and mean chosen-token logprob in the tsdb

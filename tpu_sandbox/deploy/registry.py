@@ -169,7 +169,15 @@ def deploy_events(kv) -> list[dict]:
 def load_step_params(step_dir: str | os.PathLike, template):
     """Checksum-verified restore of a registered export into
     ``template``'s structure. Raises on torn/corrupt artifacts — the
-    replica treats that as a failed swap, never a partial load."""
+    replica treats that as a failed swap, never a partial load.
+
+    Staging is chunk-streamed (``runtime.staging.stream_load_npz`` under
+    ``ShardedCheckpoint._load``): each member decompresses straight into
+    its preallocated array in bounded chunks instead of ``np.load``'s
+    whole-member bytes copy, so a swap's peak host memory is ~one model
+    instead of two plus the largest member. The verify-before-touch
+    checksum pass is unchanged — bytes on disk are hashed before any
+    parse."""
     from tpu_sandbox.train.checkpoint import load_exported_params
 
     return load_exported_params(step_dir, template)

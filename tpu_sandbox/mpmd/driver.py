@@ -39,7 +39,7 @@ from tpu_sandbox.mpmd.program import (
     merge_stage_params,
     stage_params,
 )
-from tpu_sandbox.mpmd.schedule import bubble_fraction, one_f_one_b
+from tpu_sandbox.mpmd.schedule import bubble_fraction, ops_for
 from tpu_sandbox.mpmd.transport import EdgeNames, LocalTransport
 from tpu_sandbox.obs.metrics import get_registry
 from tpu_sandbox.obs.record import get_recorder
@@ -63,12 +63,13 @@ class StageWorker:
 
     def __init__(self, program: StageProgram, params, opt_state, transport,
                  *, generation: int = 0, checkpoint: HostCheckpoint | None
-                 = None, get_timeout: float = 60.0):
+                 = None, get_timeout: float = 60.0, kind: str = "1f1b"):
         self.program = program
         self.transport = transport
         self.generation = generation
         self.checkpoint = checkpoint
         self.get_timeout = get_timeout
+        self.kind = kind
         self.params = program.place(params)
         self.opt_state = (program.init_opt_state(self.params)
                           if opt_state is None else program.place(opt_state))
@@ -77,8 +78,8 @@ class StageWorker:
             "params": jax.tree.map(np.asarray, params),
             "opt_state": jax.tree.map(np.asarray, self.opt_state),
         }
-        self.ops = one_f_one_b(program.stage, program.n_stages,
-                               program.microbatches)
+        self.ops = ops_for(kind, program.stage, program.n_stages,
+                           program.microbatches)
         s = program.stage
         self.act_in = EdgeNames(s - 1).act if not program.is_first else None
         self.act_out = EdgeNames(s).act if not program.is_last else None
@@ -87,6 +88,11 @@ class StageWorker:
         self.next_step = 0
         self.losses: dict[int, float] = {}
         self.step_seconds: dict[int, float] = {}
+        #: op -> list of measured compute seconds, one entry per executed
+        #: op — the same durations the "stage:op" spans carry, kept
+        #: in-memory so schedule.autotune_plan can read them without a
+        #: trace round-trip
+        self.op_seconds: dict[str, list[float]] = {}
         #: step -> measured bubble fraction (1 - compute/wall); the same
         #: number is published online as the ``mpmd.bubble_fraction``
         #: gauge and derivable offline from the stage:op/stage:step spans
@@ -140,9 +146,35 @@ class StageWorker:
         # constant span names (GL-O403) with stage/step/mb riding args
         rec = get_recorder()
         s = prog.stage
+        zb = self.kind == "zb_h1"
         compute_s = 0.0
         t0 = time.perf_counter()
         t_step = time.monotonic()
+
+        def timed(op_name, mb, fn, *fn_args):
+            # block_until_ready inside the timer: async dispatch would
+            # otherwise book the compute under whatever forces it next,
+            # and these durations feed schedule.autotune_plan
+            nonlocal compute_s
+            t_op = time.monotonic()
+            out = jax.block_until_ready(fn(self.params, *fn_args))
+            dt = time.monotonic() - t_op
+            compute_s += dt
+            self.op_seconds.setdefault(op_name, []).append(dt)
+            rec.complete("stage:op", t_op,
+                         args={"stage": s, "step": step,
+                               "op": op_name, "mb": mb})
+            return out
+
+        def waited(edge, mb, op_name):
+            t_wait = time.monotonic()
+            self._consume(edge, step, mb)
+            (v,) = tr.get(edge, step, mb, timeout=self.get_timeout)
+            rec.complete("stage:wait", t_wait,
+                         args={"stage": s, "step": step,
+                               "op": op_name, "mb": mb})
+            return prog.place(v)
+
         for idx, (op, m) in enumerate(self.ops):
             self._maybe_fail(step, idx)
             if self.on_op is not None:
@@ -151,63 +183,56 @@ class StageWorker:
                 if prog.is_first:
                     x = prog.place(np.asarray(tokens_mb[m]))
                 else:
-                    t_wait = time.monotonic()
-                    self._consume(self.act_in, step, m)
-                    (h,) = tr.get(self.act_in, step, m,
-                                  timeout=self.get_timeout)
-                    rec.complete("stage:wait", t_wait,
-                                 args={"stage": s, "step": step,
-                                       "op": "F", "mb": m})
-                    x = prog.place(h)
+                    x = waited(self.act_in, m, "F")
                 stash[m] = x
                 if not prog.is_last:
-                    t_op = time.monotonic()
-                    h_out = prog.fwd(self.params, x)
-                    compute_s += time.monotonic() - t_op
-                    rec.complete("stage:op", t_op,
-                                 args={"stage": s, "step": step,
-                                       "op": "F", "mb": m})
-                    tr.put(self.act_out, step, m, [np.asarray(h_out)])
-            else:
+                    h_out = timed("F", m, prog.fwd, x)
+                    tr.put(self.act_out, step, m, [h_out])
+            elif op == "B":
                 if prog.is_last:
-                    t_op = time.monotonic()
-                    lv, gp, gh = prog.loss_grad(
-                        self.params, stash.pop(m),
-                        prog.place(np.asarray(targets_mb[m])))
-                    compute_s += time.monotonic() - t_op
-                    rec.complete("stage:op", t_op,
-                                 args={"stage": s, "step": step,
-                                       "op": "B", "mb": m})
+                    x = stash[m] if zb else stash.pop(m)
+                    tg = prog.place(np.asarray(targets_mb[m]))
+                    if zb:
+                        lv, gh, st = timed("B", m, prog.loss_bwd_input, x, tg)
+                        stash[m] = (tg, st)  # W: pure weight grads
+                    else:
+                        lv, gp, gh = timed("B", m, prog.loss_grad, x, tg)
                     # ship the upstream cotangent before anything else:
                     # the previous stage is waiting on it
-                    tr.put(self.grad_out, step, m, [np.asarray(gh)])
+                    tr.put(self.grad_out, step, m, [gh])
                     loss = loss + np.float32(lv)
-                    per_mb[m] = jax.tree.map(np.asarray, gp)
-                else:
-                    t_wait = time.monotonic()
-                    self._consume(self.grad_in, step, m)
-                    (g,) = tr.get(self.grad_in, step, m,
-                                  timeout=self.get_timeout)
-                    rec.complete("stage:wait", t_wait,
-                                 args={"stage": s, "step": step,
-                                       "op": "B", "mb": m})
-                    t_op = time.monotonic()
-                    gp, gx = prog.bwd(self.params, stash.pop(m),
-                                      prog.place(g))
-                    compute_s += time.monotonic() - t_op
-                    rec.complete("stage:op", t_op,
-                                 args={"stage": s, "step": step,
-                                       "op": "B", "mb": m})
+                    if not zb:
+                        per_mb[m] = jax.tree.map(np.asarray, gp)
+                elif zb:
+                    g = waited(self.grad_in, m, "B")
+                    x = stash[m]
                     if not prog.is_first:
-                        tr.put(self.grad_out, step, m, [np.asarray(gx)])
+                        gx, st = timed("B", m, prog.bwd_input, x, g)
+                        tr.put(self.grad_out, step, m, [gx])
+                        stash[m] = st  # per-layer pairs, W is chain-free
+                    else:
+                        # stage 0's chain rides inside W (nothing
+                        # upstream consumes its grad-input)
+                        stash[m] = (x, g)
+                else:
+                    g = waited(self.grad_in, m, "B")
+                    gp, gx = timed("B", m, prog.bwd, stash.pop(m), g)
+                    if not prog.is_first:
+                        tr.put(self.grad_out, step, m, [gx])
                     per_mb[m] = jax.tree.map(np.asarray, gp)
+            else:  # "W": the deferred grad-weight pass (ZB-H1 only)
+                if prog.is_last:
+                    tg, st = stash.pop(m)
+                    gp = timed("W", m, prog.loss_bwd_weight, tg, st)
+                elif prog.is_first:
+                    x, g = stash.pop(m)
+                    gp = timed("W", m, prog.bwd_weight_chain, x, g)
+                else:
+                    gp = timed("W", m, prog.bwd_weight, stash.pop(m))
+                per_mb[m] = jax.tree.map(np.asarray, gp)
         grads = accumulate_descending(per_mb)
-        t_op = time.monotonic()
-        self.params, self.opt_state = prog.apply_grads(
-            self.params, self.opt_state, prog.place(grads))
-        compute_s += time.monotonic() - t_op
-        rec.complete("stage:op", t_op,
-                     args={"stage": s, "step": step, "op": "A", "mb": -1})
+        self.params, self.opt_state = timed(
+            "A", -1, prog.apply_grads, self.opt_state, prog.place(grads))
         wall = time.perf_counter() - t0
         self.step_seconds[step] = wall
         bubble = max(0.0, 1.0 - compute_s / wall) if wall > 0 else 0.0
@@ -267,11 +292,14 @@ class MPMDPipeline:
 
     def __init__(self, config, tx, *, n_stages: int = 2,
                  microbatches: int = 4, transport=None, devices=None,
-                 ckpt_root=None, get_timeout: float = 60.0):
+                 ckpt_root=None, get_timeout: float = 60.0,
+                 kind: str = "1f1b", layer_split=None):
         self.config = config
         self.tx = tx
         self.n_stages = n_stages
         self.microbatches = microbatches
+        self.kind = kind
+        self.layer_split = layer_split
         self.transport = LocalTransport() if transport is None else transport
         if devices is None:
             devs = jax.devices()
@@ -279,7 +307,7 @@ class MPMDPipeline:
         self.devices = devices
         self.programs = [
             StageProgram(config, tx, s, n_stages, microbatches,
-                         device=devices[s])
+                         device=devices[s], layer_split=layer_split)
             for s in range(n_stages)
         ]
         self.ckpt_root = ckpt_root
@@ -301,10 +329,11 @@ class MPMDPipeline:
         parity tests seed both engines identically this way)."""
         self.workers = [
             StageWorker(self.programs[s],
-                        stage_params(flat_params, s, self.n_stages),
+                        stage_params(flat_params, s, self.n_stages,
+                                     layer_split=self.layer_split),
                         None, self.transport,
                         checkpoint=self._checkpoint_for(s),
-                        get_timeout=self.get_timeout)
+                        get_timeout=self.get_timeout, kind=self.kind)
             for s in range(self.n_stages)
         ]
 
@@ -325,7 +354,8 @@ class MPMDPipeline:
             old.program, old._template["params"],
             old._template["opt_state"], self.transport,
             generation=self._generations[stage],
-            checkpoint=old.checkpoint, get_timeout=self.get_timeout)
+            checkpoint=old.checkpoint, get_timeout=self.get_timeout,
+            kind=old.kind)
         worker.restore_checkpoint()
         # carry the audit trail across the relaunch
         worker.applied_steps = list(old.applied_steps)
@@ -420,3 +450,13 @@ class MPMDPipeline:
 
     def stage_step_seconds(self) -> list[dict[int, float]]:
         return [dict(w.step_seconds) for w in self.workers]
+
+    def measured_op_costs(self) -> dict[int, dict[str, float]]:
+        """Median measured compute seconds per (stage, op) — the input
+        :func:`~tpu_sandbox.mpmd.schedule.autotune_plan` expects. Fused
+        runs report F/B/A; ZB runs additionally report W."""
+        out: dict[int, dict[str, float]] = {}
+        for s, w in enumerate(self.workers):
+            out[s] = {op: float(np.median(ts))
+                      for op, ts in w.op_seconds.items() if ts}
+        return out

@@ -50,15 +50,43 @@ from tpu_sandbox.parallel.pipeline import (
 )
 
 
-def stage_params(flat_params: dict, stage: int, n_stages: int) -> dict:
+def check_layer_split(n_layers: int, n_stages: int,
+                      layer_split) -> list[int]:
+    """Validate (or derive) the per-stage layer counts. ``None`` keeps
+    the original contract: layers must divide evenly."""
+    if layer_split is None:
+        if n_layers % n_stages:
+            raise ValueError(
+                f"{n_layers} layers not divisible into {n_stages} stages "
+                "(pass layer_split for an uneven pipeline)")
+        return [n_layers // n_stages] * n_stages
+    split = [int(x) for x in layer_split]
+    if len(split) != n_stages:
+        raise ValueError(
+            f"layer_split {split} has {len(split)} entries for "
+            f"{n_stages} stages")
+    if any(x < 1 for x in split) or sum(split) != n_layers:
+        raise ValueError(
+            f"layer_split {split} must be positive and sum to {n_layers}")
+    return split
+
+
+def stage_params(flat_params: dict, stage: int, n_stages: int, *,
+                 layer_split=None) -> dict:
     """Slice a full TransformerLM param tree to one stage's subtree:
-    ``{"stages": [lps, ...]}`` plus ``"pre"`` on stage 0 and ``"post"``
-    on the last stage — the same leaves the SPMD engine shards to that
-    pipe rank, so checkpoints interchange leaf-for-leaf."""
-    pre, stacked, post = split_transformer_params(flat_params, n_stages)
-    lps = jax.tree.leaves(stacked)[0].shape[0] // n_stages
-    sliced = jax.tree.map(
-        lambda x: np.asarray(x)[stage * lps:(stage + 1) * lps], stacked)
+    ``{"stages": [layers_of_stage, ...]}`` plus ``"pre"`` on stage 0 and
+    ``"post"`` on the last stage — the same leaves the SPMD engine
+    shards to that pipe rank, so checkpoints interchange leaf-for-leaf.
+    ``layer_split`` gives each stage's layer count for uneven
+    pipelines."""
+    # n_stages=1 skips the splitter's own divisibility check — uneven
+    # pipelines validate through check_layer_split instead
+    pre, stacked, post = split_transformer_params(flat_params, 1)
+    n_layers = jax.tree.leaves(stacked)[0].shape[0]
+    split = check_layer_split(n_layers, n_stages, layer_split)
+    lo = sum(split[:stage])
+    hi = lo + split[stage]
+    sliced = jax.tree.map(lambda x: np.asarray(x)[lo:hi], stacked)
     out = {"stages": sliced}
     if stage == 0:
         out["pre"] = jax.tree.map(np.asarray, pre)
@@ -109,11 +137,10 @@ class StageProgram:
 
     def __init__(self, config: TransformerConfig,
                  tx: optax.GradientTransformation, stage: int,
-                 n_stages: int, microbatches: int, *, device=None):
-        if config.n_layers % n_stages:
-            raise ValueError(
-                f"{config.n_layers} layers not divisible into "
-                f"{n_stages} stages")
+                 n_stages: int, microbatches: int, *, device=None,
+                 layer_split=None):
+        self.layer_split = check_layer_split(config.n_layers, n_stages,
+                                             layer_split)
         self.config = config
         self.tx = tx
         self.stage = stage
@@ -182,10 +209,95 @@ class StageProgram:
             updates, new_opt = self.tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt
 
+        # -- ZB-H1 backward split: grad-input (B) vs grad-weight (W) as
+        # separate programs. B runs the cotangent chain layer by layer
+        # and stashes each layer's (input, output-cotangent) pair; W is
+        # then PURE weight-grad work from the stash — it never re-walks
+        # the chain, which is what makes deferring it into the drain
+        # bubble a win instead of a 2x backward. The split is exact
+        # math against the fused backward but NOT bitwise: each
+        # per-layer vjp compiles as its own XLA unit, whose reduction
+        # grouping differs from the fused scan transpose by a few ulps
+        # (parity held at 1e-6 loss / per-leaf allclose by
+        # tests/test_mpmd_fastfabric.py). The bitwise contracts are
+        # untouched where they bind: fused 1F1B vs SPMD, and ZB vs ZB —
+        # replay after a fault re-runs the SAME split programs, so the
+        # fault matrix still lands bitwise. Stage 0 is the exception to
+        # the split: its weight grads need the internal chain anyway
+        # (nothing upstream wants its g_in), so it keeps the
+        # chain-walking W (``bwd_weight_chain``) and skips B entirely.
+
+        def _fwd_collect(params, h0):
+            # forward over the layer slice, stacking each layer's INPUT
+            def one(h, lp):
+                return self._block.apply({"params": lp}, h), h
+
+            return lax.scan(one, h0, params["stages"])
+
+        def _chain(params, hs, g_top):
+            # reverse sweep: per-layer grad-input vjp, stacking each
+            # layer's OUTPUT cotangent alongside its stashed input
+            def one(g, xs):
+                lp, h_in = xs
+                _, vjp = jax.vjp(
+                    lambda hh: self._block.apply({"params": lp}, hh), h_in)
+                return vjp(g)[0], g
+
+            return lax.scan(one, g_top, (params["stages"], hs),
+                            reverse=True)
+
+        def _weight_grads(params, stash):
+            hs, gs = stash
+
+            def one(c, xs):
+                lp, h_in, g = xs
+                _, vjp = jax.vjp(
+                    lambda p: self._block.apply({"params": p}, h_in), lp)
+                return c, vjp(g)[0]
+
+            _, g_stages = lax.scan(one, 0, (params["stages"], hs, gs))
+            return g_stages
+
+        def bwd_input(params, x, g_out):
+            _, hs = _fwd_collect(params, x)
+            gx, gs = _chain(params, hs, g_out)
+            return gx, (hs, gs)
+
+        def bwd_weight(params, stash):
+            return {"stages": _weight_grads(params, stash)}
+
+        def bwd_weight_chain(params, x, g_out):
+            # stage 0's W: the full vjp w.r.t. params (embed included) —
+            # its chain feeds nothing upstream, so it rides inside W
+            _, vjp = jax.vjp(lambda p: self._forward(p, x), params)
+            return vjp(g_out)[0]
+
+        def loss_bwd_input(params, x, targets):
+            h_out, hs = _fwd_collect(params, x)
+            lv, head_vjp = jax.vjp(
+                lambda hh: self._head_loss(params["post"], hh, targets) / M,
+                h_out)
+            (g_top,) = head_vjp(jnp.ones_like(lv))
+            gx, gs = _chain(params, hs, g_top)
+            return lv, gx, (hs, gs, h_out)
+
+        def loss_bwd_weight(params, targets, stash):
+            hs, gs, h_out = stash
+            g_post = jax.grad(
+                lambda post: self._head_loss(post, h_out, targets) / M)(
+                params["post"])
+            return {"stages": _weight_grads(params, (hs, gs)),
+                    "post": g_post}
+
         self.fwd = jax.jit(fwd)
         self.bwd = jax.jit(bwd)
         self.loss_grad = jax.jit(loss_grad)
         self.apply_grads = jax.jit(apply_grads)
+        self.bwd_input = jax.jit(bwd_input)
+        self.bwd_weight = jax.jit(bwd_weight)
+        self.bwd_weight_chain = jax.jit(bwd_weight_chain)
+        self.loss_bwd_input = jax.jit(loss_bwd_input)
+        self.loss_bwd_weight = jax.jit(loss_bwd_weight)
 
     # -- placement ----------------------------------------------------------
 
@@ -199,16 +311,37 @@ class StageProgram:
     def init_opt_state(self, params):
         return self.place(self.tx.init(params))
 
-    def lower_train_programs(self, params, sample_x, sample_targets=None):
+    def lower_train_programs(self, params, sample_x, sample_targets=None,
+                             *, zb: bool = False):
         """AOT-lower this stage's programs (fwd and, where they exist,
         bwd/loss_grad) without executing — the hook aot_mpmd.py and the
-        graftlint HLO pass share."""
+        graftlint HLO pass share. With ``zb`` the split ZB-H1 backward
+        pair (grad-input / grad-weight) is lowered alongside, so the AOT
+        receipt shows what each half's executable actually carries."""
         out = {}
         if self.is_last:
             out["loss_grad"] = self.loss_grad.lower(
                 params, sample_x, sample_targets)
+            if zb:
+                out["loss_bwd_input"] = self.loss_bwd_input.lower(
+                    params, sample_x, sample_targets)
+                _, _, stash = jax.eval_shape(
+                    self.loss_bwd_input, params, sample_x, sample_targets)
+                out["loss_bwd_weight"] = self.loss_bwd_weight.lower(
+                    params, sample_targets, stash)
         else:
             out["fwd"] = self.fwd.lower(params, sample_x)
             g = jax.eval_shape(self.fwd, params, sample_x)
             out["bwd"] = self.bwd.lower(params, sample_x, g)
+            if zb:
+                if self.is_first:
+                    out["bwd_weight"] = self.bwd_weight_chain.lower(
+                        params, sample_x, g)
+                else:
+                    out["bwd_input"] = self.bwd_input.lower(
+                        params, sample_x, g)
+                    _, stash = jax.eval_shape(
+                        self.bwd_input, params, sample_x, g)
+                    out["bwd_weight"] = self.bwd_weight.lower(
+                        params, stash)
         return out

@@ -68,6 +68,11 @@ def main(argv=None) -> int:
     p.add_argument("--n-stages", type=int, default=0)
     p.add_argument("--microbatches", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--schedule-kind", default="1f1b",
+                   help="schedule family: 1f1b | zb_h1 (leader only)")
+    p.add_argument("--layer-split", default="",
+                   help="json list of per-stage layer counts for uneven "
+                   "pipelines (leader only; others read the plan)")
     p.add_argument("--model", default="", help="TransformerConfig kwargs "
                    "json (leader only; others read the plan)")
     p.add_argument("--optimizer", default="",
@@ -123,6 +128,9 @@ def main(argv=None) -> int:
         publish_plan(
             kv, n_stages=args.n_stages, microbatches=args.microbatches,
             steps=args.steps, seed=args.seed, prefix=prefix,
+            kind=args.schedule_kind,
+            layer_split=(json.loads(args.layer_split)
+                         if args.layer_split else None),
             extra={
                 "model": json.loads(args.model or "{}"),
                 "optimizer": json.loads(args.optimizer or "{}"),
@@ -130,6 +138,7 @@ def main(argv=None) -> int:
             })
     plan = fetch_plan(kv, prefix=prefix, timeout=args.get_timeout)
     n_stages, microbatches = plan["n_stages"], plan["microbatches"]
+    kind, layer_split = plan["kind"], plan["layer_split"]
 
     config = TransformerConfig(**plan["model"])
     tx = _build_tx(plan["optimizer"])
@@ -144,14 +153,16 @@ def main(argv=None) -> int:
         np.asarray,
         TransformerLM(config).init(jax.random.key(plan["seed"]),
                                    tokens)["params"])
-    program = StageProgram(config, tx, stage, n_stages, microbatches)
+    program = StageProgram(config, tx, stage, n_stages, microbatches,
+                           layer_split=layer_split)
     transport = KVTransport(kv, prefix=f"{prefix}/")
     generation = kv.add(f"{prefix}/gen/{stage}", 1)
     worker = StageWorker(
-        program, stage_params(flat, stage, n_stages), None, transport,
-        generation=generation,
+        program,
+        stage_params(flat, stage, n_stages, layer_split=layer_split),
+        None, transport, generation=generation,
         checkpoint=HostCheckpoint(f"{args.ckpt_root}/stage-{stage}"),
-        get_timeout=args.get_timeout)
+        get_timeout=args.get_timeout, kind=kind)
     worker.restore_checkpoint()
 
     # -- fault plan + agent mailbox, polled at every op boundary -------------

@@ -98,11 +98,15 @@ class PrefillReplica(_Replica):
         alloc.length = plen
         self.cache.commit_prefix(alloc)
         # exactly this request's block rows, in block-table order — the
-        # decode side re-homes them at its own ids, contents untouched
-        k_rows = np.asarray(self.k_pages)[:, alloc.block_ids]
-        v_rows = np.asarray(self.v_pages)[:, alloc.block_ids]
+        # decode side re-homes them at its own ids, contents untouched.
+        # The gather happens ON DEVICE: only the request's rows are ever
+        # staged (pack_views stages them for host wires; DeviceTransport
+        # ships the device buffers as-is), never the whole page pool.
+        ids = jnp.asarray(alloc.block_ids)
+        k_rows = self.k_pages[:, ids]
+        v_rows = self.v_pages[:, ids]
         self.transport.put(_edge(req.rid), 0, 0,
-                           [k_rows, v_rows, np.asarray(next_logits)])
+                           [k_rows, v_rows, next_logits])
         self.cache.free(alloc)
 
 

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tpu_sandbox.runtime.staging import stream_load_npz
 from tpu_sandbox.train.state import TrainState
 
 
@@ -358,22 +359,24 @@ class HostCheckpoint:
         problem = verify_npz_sidecar(self._path(step))
         if problem is not None:
             raise ValueError(problem)
-        with np.load(self._path(step), allow_pickle=False) as z:
-            meta = json.loads(str(z["__meta__"]))
-            leaves, treedef = _flatten_with_paths(template)
-            restored = []
-            for path, leaf in leaves:
-                key = f"leaf:{path}"
-                if key not in z:
-                    raise KeyError(f"checkpoint misses leaf {path!r}")
-                arr = _from_savable(z[key], meta["dtypes"].get(path))
-                want = np.shape(leaf)
-                if tuple(arr.shape) != tuple(want):
-                    raise ValueError(
-                        f"leaf {path!r}: checkpoint shape {arr.shape} != "
-                        f"template shape {want}"
-                    )
-                restored.append(arr)
+        # chunk-streamed staging: each member lands directly in its
+        # preallocated array instead of np.load's whole-member copies
+        z = stream_load_npz(self._path(step))
+        meta = json.loads(str(z["__meta__"]))
+        leaves, treedef = _flatten_with_paths(template)
+        restored = []
+        for path, leaf in leaves:
+            key = f"leaf:{path}"
+            if key not in z:
+                raise KeyError(f"checkpoint misses leaf {path!r}")
+            arr = _from_savable(z[key], meta["dtypes"].get(path))
+            want = np.shape(leaf)
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"leaf {path!r}: checkpoint shape {arr.shape} != "
+                    f"template shape {want}"
+                )
+            restored.append(arr)
         return jax.tree_util.tree_unflatten(treedef, restored), meta
 
     def restore(self, template, step: int | None = None):
@@ -810,13 +813,15 @@ class ShardedCheckpoint:
         shard_data: list[dict] = []
         shard_dtypes: list[dict] = []
         for sh in sorted(manifest["shards"], key=lambda s: s["rank"]):
-            with np.load(sd / sh["file"], allow_pickle=False) as z:
-                meta = json.loads(str(z["__meta__"]))
-                shard_data.append(
-                    {k[len("leaf:"):]: z[k].copy() for k in z.files
-                     if k.startswith("leaf:")}
-                )
-                shard_dtypes.append(meta.get("dtypes", {}))
+            # chunk-streamed staging (the deploy swap path stages every
+            # shard through here): no whole-file copy, no z[k].copy()
+            z = stream_load_npz(sd / sh["file"])
+            meta = json.loads(str(z["__meta__"]))
+            shard_data.append(
+                {k[len("leaf:"):]: z[k] for k in z
+                 if k.startswith("leaf:")}
+            )
+            shard_dtypes.append(meta.get("dtypes", {}))
         leaves, treedef = _flatten_with_paths(template)
         restored = []
         for path, tleaf in leaves:
@@ -915,13 +920,13 @@ class ShardedCheckpoint:
                     f"shard {r} sha256 {digest[:12]}... != manifest "
                     f"{sh['sha256'][:12]}..."
                 )
-            with np.load(f, allow_pickle=False) as z:
-                meta = json.loads(str(z["__meta__"]))
-                shard_data[r] = {
-                    k[len("leaf:"):]: z[k].copy() for k in z.files
-                    if k.startswith("leaf:")
-                }
-                shard_dtypes[r] = meta.get("dtypes", {})
+            z = stream_load_npz(f)
+            meta = json.loads(str(z["__meta__"]))
+            shard_data[r] = {
+                k[len("leaf:"):]: z[k] for k in z
+                if k.startswith("leaf:")
+            }
+            shard_dtypes[r] = meta.get("dtypes", {})
         spec: dict = manifest["spec"]
         leaves, treedef = _flatten_with_paths(template)
         restored = []
